@@ -1,0 +1,12 @@
+"""katib_tpu — a TPU-native AutoML framework.
+
+Hyperparameter tuning (random/grid/TPE/multivariate-TPE/GP-BO/CMA-ES/Sobol/
+Hyperband), population-based training, early stopping, and neural architecture
+search (DARTS, ENAS), built for JAX/XLA on Cloud TPU.  Capability parity with
+kubeflow/katib (see SURVEY.md), redesigned: trials are white-box JAX functions
+on TPU meshes, metrics stream in-process, checkpoints are Orbax pytrees.
+"""
+
+__version__ = "0.1.0"
+
+from katib_tpu.core import types as types  # noqa: F401

@@ -1,0 +1,2 @@
+from katib_tpu.core.types import *  # noqa: F401,F403
+from katib_tpu.core.validation import ValidationError, validate_experiment  # noqa: F401

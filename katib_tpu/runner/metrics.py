@@ -1,0 +1,131 @@
+"""Metrics-line parsing for black-box trials.
+
+White-box JAX trials report metrics through a direct callback, so they never
+touch this module.  Black-box subprocess trials (arbitrary-language training
+scripts) write lines to stdout or a file, and this parser extracts metric
+points — functional parity with the reference's file/stdout metrics-collector
+sidecar (``pkg/metricscollector/v1beta1/file-metricscollector/file-metricscollector.go:45``)
+minus the pod machinery (no shared-PID-namespace scans, ``$$$$.pid`` completion
+markers or SIGTERM dances: the runner owns the subprocess handle directly).
+
+Formats:
+- TEXT: ``name=value`` pairs matched by a filter regex, optional leading
+  RFC3339 timestamp (reference ``parseLogsInTextFormat``; default filter
+  ``common/const.go:47``).
+- JSON lines: one object per line; metric keys map to values, optional
+  ``timestamp`` key (reference ``parseLogsInJsonFormat``).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+import time
+from datetime import datetime
+from typing import Sequence
+
+from katib_tpu.core.types import MetricLog
+
+# Reference default filter (``pkg/metricscollector/v1beta1/common/const.go:47``):
+# word-ish metric name, '=', float with optional sign/decimals/exponent.
+DEFAULT_TEXT_FILTER = r"([\w|-]+)\s*=\s*([+-]?\d*(?:\.\d+)?(?:[Ee][+-]?\d+)?)"
+
+# Reported when the objective metric never appears in the logs (reference
+# ``consts.UnavailableMetricValue``); the orchestrator turns this into the
+# MetricsUnavailable trial condition.
+UNAVAILABLE_METRIC_VALUE = "unavailable"
+
+
+def _parse_rfc3339(token: str) -> float | None:
+    try:
+        return datetime.fromisoformat(token.replace("Z", "+00:00")).timestamp()
+    except ValueError:
+        return None
+
+
+def parse_text_lines(
+    lines: Sequence[str],
+    metric_names: Sequence[str],
+    filters: Sequence[str] = (),
+) -> list[MetricLog]:
+    """Parse TEXT-format log lines into metric points.
+
+    Only lines containing a tracked metric name are inspected; each filter
+    regex must expose (name, value) capture groups; names not in
+    ``metric_names`` are dropped (reference ``parseLogsInTextFormat``).
+    """
+    regs = [re.compile(f) for f in (filters or [DEFAULT_TEXT_FILTER])]
+    names = set(metric_names)
+    out: list[MetricLog] = []
+    for line in lines:
+        if not any(m in line for m in names):
+            continue
+        ts = 0.0
+        head = line.split(" ", 1)[0]
+        parsed = _parse_rfc3339(head) if head else None
+        if parsed is not None:
+            ts = parsed
+        for reg in regs:
+            for match in reg.finditer(line):
+                if match.lastindex is None or match.lastindex < 2:
+                    continue
+                name = match.group(1).strip()
+                raw = match.group(2).strip()
+                if name not in names or not raw:
+                    continue
+                try:
+                    value = float(raw)
+                except ValueError:
+                    continue
+                out.append(MetricLog(metric_name=name, value=value, timestamp=ts))
+    return out
+
+
+def parse_json_lines(
+    lines: Sequence[str], metric_names: Sequence[str]
+) -> list[MetricLog]:
+    """Parse JSON-lines logs; each line is an object whose keys may include
+    tracked metric names and an optional ``timestamp`` (string RFC3339 or
+    epoch number)."""
+    out: list[MetricLog] = []
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"failed to parse json log line: {line[:120]!r}") from e
+        if not isinstance(obj, dict):
+            continue
+        ts = 0.0
+        raw_ts = obj.get("timestamp")
+        if isinstance(raw_ts, (int, float)):
+            ts = float(raw_ts)
+        elif isinstance(raw_ts, str):
+            ts = _parse_rfc3339(raw_ts) or 0.0
+        step = obj.get("step", -1)
+        if not isinstance(step, int):
+            step = -1
+        for name in metric_names:
+            if name not in obj:
+                continue
+            try:
+                value = float(obj[name])
+            except (TypeError, ValueError):
+                continue
+            out.append(MetricLog(metric_name=name, value=value, timestamp=ts, step=step))
+    return out
+
+
+def objective_reported(logs: Sequence[MetricLog], objective_metric: str) -> bool:
+    """Reference ``newObservationLog``: logs must contain at least one finite
+    objective point, else the trial is MetricsUnavailable."""
+    return any(
+        l.metric_name == objective_metric and math.isfinite(l.value) for l in logs
+    )
+
+
+def now_metric(name: str, value: float, step: int = -1) -> MetricLog:
+    return MetricLog(metric_name=name, value=value, timestamp=time.time(), step=step)

@@ -1,0 +1,2 @@
+from katib_tpu.store.base import MemoryObservationStore, ObservationStore  # noqa: F401
+from katib_tpu.store.sqlite import SqliteObservationStore  # noqa: F401

@@ -1,0 +1,80 @@
+"""SQLite observation-log backend.
+
+Durable equivalent of the reference DB-manager's MySQL/Postgres table
+``observation_logs(trial_name, id, time, metric_name, value)``
+(``pkg/db/v1beta1/mysql/init.go:35``) without the standalone daemon: the
+orchestrator embeds the store, so the sidecar→gRPC→SQL hop disappears.
+Schema keeps an extra ``step`` column because white-box trials report
+structured (step, value) points rather than parsed log lines.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+from typing import Iterable
+
+from katib_tpu.core.types import MetricLog
+from katib_tpu.store.base import ObservationStore
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS observation_logs (
+    id          INTEGER PRIMARY KEY AUTOINCREMENT,
+    trial_name  TEXT    NOT NULL,
+    time        REAL    NOT NULL,
+    metric_name TEXT    NOT NULL,
+    value       REAL    NOT NULL,
+    step        INTEGER NOT NULL DEFAULT -1
+);
+CREATE INDEX IF NOT EXISTS idx_obs_trial ON observation_logs (trial_name, metric_name, id);
+"""
+
+
+class SqliteObservationStore(ObservationStore):
+    def __init__(self, path: str = ":memory:") -> None:
+        # one shared connection guarded by a lock: sqlite serializes writers
+        # anyway, and this keeps ':memory:' stores coherent across threads.
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.RLock()
+        with self._lock:
+            self._conn.executescript(_SCHEMA)
+            self._conn.commit()
+
+    def report(self, trial_name: str, logs: Iterable[MetricLog]) -> None:
+        rows = [(trial_name, l.timestamp, l.metric_name, l.value, l.step) for l in logs]
+        if not rows:
+            return
+        with self._lock:
+            self._conn.executemany(
+                "INSERT INTO observation_logs (trial_name, time, metric_name, value, step)"
+                " VALUES (?, ?, ?, ?, ?)",
+                rows,
+            )
+            self._conn.commit()
+
+    def get(self, trial_name: str, metric_name: str | None = None) -> list[MetricLog]:
+        q = (
+            "SELECT metric_name, value, time, step FROM observation_logs"
+            " WHERE trial_name = ?"
+        )
+        args: list = [trial_name]
+        if metric_name is not None:
+            q += " AND metric_name = ?"
+            args.append(metric_name)
+        q += " ORDER BY id"
+        with self._lock:
+            rows = self._conn.execute(q, args).fetchall()
+        return [
+            MetricLog(metric_name=m, value=v, timestamp=t, step=s) for (m, v, t, s) in rows
+        ]
+
+    def delete(self, trial_name: str) -> None:
+        with self._lock:
+            self._conn.execute(
+                "DELETE FROM observation_logs WHERE trial_name = ?", (trial_name,)
+            )
+            self._conn.commit()
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()

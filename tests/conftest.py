@@ -1,0 +1,15 @@
+"""Test configuration: force an 8-device virtual CPU platform so sharding
+tests exercise real multi-device meshes without TPU hardware (the driver's
+dryrun uses the same mechanism)."""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

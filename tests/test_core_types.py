@@ -1,0 +1,232 @@
+"""Core type-layer tests (parity targets: reference experiment/trial CRD
+semantics + webhook validation, see SURVEY.md §2.1)."""
+
+import math
+
+import pytest
+
+from katib_tpu.core.types import (
+    AlgorithmSpec,
+    ComparisonOp,
+    Distribution,
+    Experiment,
+    ExperimentSpec,
+    FeasibleSpace,
+    Metric,
+    MetricStrategy,
+    MetricStrategyType,
+    MetricsCollectorKind,
+    MetricsCollectorSpec,
+    Observation,
+    ObjectiveSpec,
+    ObjectiveType,
+    ParameterAssignment,
+    ParameterSpec,
+    ParameterType,
+    Trial,
+    TrialCondition,
+    TrialSpec,
+)
+from katib_tpu.core.validation import ValidationError, validate_experiment
+
+
+def make_objective(**kw):
+    defaults = dict(
+        type=ObjectiveType.MAXIMIZE,
+        objective_metric_name="accuracy",
+        goal=0.99,
+        additional_metric_names=("loss",),
+    )
+    defaults.update(kw)
+    return ObjectiveSpec(**defaults)
+
+
+def make_spec(**kw):
+    defaults = dict(
+        name="exp",
+        objective=make_objective(),
+        algorithm=AlgorithmSpec(name="random"),
+        parameters=[
+            ParameterSpec("lr", ParameterType.DOUBLE, FeasibleSpace(min=0.01, max=0.1)),
+            ParameterSpec(
+                "units", ParameterType.INT, FeasibleSpace(min=8, max=64, step=8)
+            ),
+            ParameterSpec(
+                "opt", ParameterType.CATEGORICAL, FeasibleSpace(list=("sgd", "adam"))
+            ),
+        ],
+        train_fn=lambda ctx: None,
+    )
+    defaults.update(kw)
+    return ExperimentSpec(**defaults)
+
+
+class TestFeasibleSpace:
+    def test_double_requires_bounds(self):
+        with pytest.raises(ValueError):
+            ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min=0.1))
+
+    def test_log_requires_positive_min(self):
+        with pytest.raises(ValueError):
+            ParameterSpec(
+                "x",
+                ParameterType.DOUBLE,
+                FeasibleSpace(min=0.0, max=1.0, distribution=Distribution.LOG_UNIFORM),
+            )
+
+    def test_categorical_requires_list(self):
+        with pytest.raises(ValueError):
+            ParameterSpec("x", ParameterType.CATEGORICAL, FeasibleSpace())
+
+    def test_int_grid_values(self):
+        p = ParameterSpec("x", ParameterType.INT, FeasibleSpace(min=1, max=10, step=3))
+        assert p.grid_values() == [1, 4, 7, 10]
+
+    def test_double_grid_with_step(self):
+        p = ParameterSpec(
+            "x", ParameterType.DOUBLE, FeasibleSpace(min=0.0, max=1.0, step=0.25)
+        )
+        assert p.grid_values() == pytest.approx([0.0, 0.25, 0.5, 0.75, 1.0])
+
+    def test_cast(self):
+        p = ParameterSpec("x", ParameterType.INT, FeasibleSpace(min=0, max=10))
+        assert p.cast("3.0") == 3
+        assert p.cast(3.6) == 4
+
+    def test_contains(self):
+        p = ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min=0.0, max=1.0))
+        assert p.contains(0.5)
+        assert not p.contains(1.5)
+
+
+class TestObjective:
+    def test_better(self):
+        assert ObjectiveType.MINIMIZE.better(0.1, 0.2)
+        assert ObjectiveType.MAXIMIZE.better(0.9, 0.2)
+
+    def test_default_strategies(self):
+        obj = make_objective()
+        # maximize objective -> max strategy; additional metrics -> latest
+        assert obj.strategy_for("accuracy") is MetricStrategyType.MAX
+        assert obj.strategy_for("loss") is MetricStrategyType.LATEST
+
+    def test_explicit_strategy_overrides(self):
+        obj = make_objective(
+            metric_strategies=(MetricStrategy("accuracy", MetricStrategyType.LATEST),)
+        )
+        assert obj.strategy_for("accuracy") is MetricStrategyType.LATEST
+
+    def test_goal(self):
+        obj = make_objective(goal=0.95)
+        assert obj.is_goal_reached(0.96)
+        assert not obj.is_goal_reached(0.94)
+        mini = make_objective(type=ObjectiveType.MINIMIZE, goal=0.1)
+        assert mini.is_goal_reached(0.05)
+
+    def test_strategy_reduce(self):
+        vals = [3.0, 1.0, 2.0]
+        assert MetricStrategyType.MIN.reduce(vals) == 1.0
+        assert MetricStrategyType.MAX.reduce(vals) == 3.0
+        assert MetricStrategyType.LATEST.reduce(vals) == 2.0
+
+
+class TestComparison:
+    def test_ops(self):
+        assert ComparisonOp.LESS.holds(0.1, 0.2)
+        assert ComparisonOp.GREATER.holds(0.3, 0.2)
+        assert ComparisonOp.EQUAL.holds(0.2, 0.2)
+
+
+class TestValidation:
+    def test_valid(self):
+        validate_experiment(make_spec())
+
+    def test_missing_params(self):
+        with pytest.raises(ValidationError, match="parameters"):
+            validate_experiment(make_spec(parameters=[]))
+
+    def test_grid_needs_finite_space(self):
+        spec = make_spec(algorithm=AlgorithmSpec(name="grid"))
+        with pytest.raises(ValidationError, match="finite"):
+            validate_experiment(spec)
+
+    def test_grid_ok_with_steps(self):
+        spec = make_spec(
+            algorithm=AlgorithmSpec(name="grid"),
+            parameters=[
+                ParameterSpec(
+                    "lr", ParameterType.DOUBLE, FeasibleSpace(min=0.0, max=1.0, step=0.5)
+                )
+            ],
+        )
+        validate_experiment(spec)
+
+    def test_nas_requires_config(self):
+        with pytest.raises(ValidationError, match="nas_config"):
+            validate_experiment(make_spec(algorithm=AlgorithmSpec(name="darts")))
+
+    def test_exactly_one_entry_point(self):
+        with pytest.raises(ValidationError, match="train_fn or command"):
+            validate_experiment(make_spec(train_fn=None))
+
+    def test_command_placeholder_check(self):
+        spec = make_spec(
+            train_fn=None,
+            command=["python", "train.py", "--lr=${trialParameters.nope}"],
+            metrics_collector=MetricsCollectorSpec(kind=MetricsCollectorKind.STDOUT),
+        )
+        with pytest.raises(ValidationError, match="nope"):
+            validate_experiment(spec)
+
+    def test_duplicate_param_names(self):
+        spec = make_spec(
+            parameters=[
+                ParameterSpec("lr", ParameterType.DOUBLE, FeasibleSpace(min=0.0, max=1.0)),
+                ParameterSpec("lr", ParameterType.DOUBLE, FeasibleSpace(min=0.0, max=1.0)),
+            ]
+        )
+        with pytest.raises(ValidationError, match="duplicate"):
+            validate_experiment(spec)
+
+
+class TestExperimentStatus:
+    def _trial(self, name, cond, acc=None):
+        t = Trial(name=name, spec=TrialSpec(), condition=cond)
+        if acc is not None:
+            t.observation = Observation(
+                metrics=[Metric(name="accuracy", value=acc, latest=acc)]
+            )
+        return t
+
+    def test_optimal_tracking(self):
+        exp = Experiment(spec=make_spec())
+        exp.trials["a"] = self._trial("a", TrialCondition.SUCCEEDED, 0.8)
+        exp.trials["b"] = self._trial("b", TrialCondition.SUCCEEDED, 0.9)
+        exp.trials["c"] = self._trial("c", TrialCondition.FAILED, 0.99)  # ignored
+        exp.trials["d"] = self._trial("d", TrialCondition.EARLY_STOPPED, 0.85)
+        exp.update_optimal()
+        assert exp.optimal.trial_name == "b"
+        assert exp.optimal.objective_value == 0.9
+
+    def test_counts(self):
+        exp = Experiment(spec=make_spec())
+        exp.trials["a"] = self._trial("a", TrialCondition.SUCCEEDED, 0.8)
+        exp.trials["b"] = self._trial("b", TrialCondition.RUNNING)
+        exp.trials["c"] = self._trial("c", TrialCondition.FAILED)
+        exp.trials["d"] = self._trial("d", TrialCondition.EARLY_STOPPED, 0.7)
+        assert exp.succeeded_count == 1
+        assert exp.failed_count == 1
+        assert exp.running_count == 1
+        # completed = succeeded + early-stopped (reference experiment_controller.go:449-461)
+        assert exp.completed_count == 2
+
+    def test_search_space_size(self):
+        spec = make_spec()
+        assert math.isinf(spec.search_space_size())  # lr double w/o step
+        spec2 = make_spec(
+            parameters=[
+                ParameterSpec("units", ParameterType.INT, FeasibleSpace(min=8, max=24, step=8)),
+                ParameterSpec("opt", ParameterType.CATEGORICAL, FeasibleSpace(list=("a", "b"))),
+            ]
+        )
+        assert spec2.search_space_size() == 6
