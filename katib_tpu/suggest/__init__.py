@@ -1,0 +1,9 @@
+from katib_tpu.suggest.base import (  # noqa: F401
+    SearchExhausted,
+    Suggester,
+    SuggesterError,
+    SuggestionsNotReady,
+    make_suggester,
+    registered_algorithms,
+)
+from katib_tpu.suggest.space import SpaceEncoder  # noqa: F401

@@ -1,0 +1,10 @@
+"""Import side-effect module: registers all built-in suggesters."""
+
+from katib_tpu.suggest import bayesopt  # noqa: F401
+from katib_tpu.suggest import cmaes  # noqa: F401
+from katib_tpu.suggest import grid  # noqa: F401
+from katib_tpu.suggest import hyperband  # noqa: F401
+from katib_tpu.suggest import pbt  # noqa: F401
+from katib_tpu.suggest import random_search  # noqa: F401
+from katib_tpu.suggest import sobol  # noqa: F401
+from katib_tpu.suggest import tpe  # noqa: F401

@@ -1,0 +1,156 @@
+"""Suggester contract + registry.
+
+The reference runs every algorithm as a per-experiment gRPC deployment behind
+``GetSuggestions`` / ``ValidateAlgorithmSettings`` (``api.proto:34-40``, composer
+``composer.go:72``).  Here a suggester is an in-process object owned by the
+orchestrator — same contract, no pod, no network:
+
+- ``validate(spec)``        <-> ``ValidateAlgorithmSettings``
+- ``get_suggestions(...)``  <-> ``GetSuggestions`` with ``current_request_number``
+
+Statefulness contract (parity with the reference's semantics, §3.2 of
+SURVEY.md): suggesters may keep in-memory state for the lifetime of an
+experiment (hyperopt Trials store / ENAS session / PBT queue analogs) but must
+either (a) derive state from the trial history passed in (random/grid/TPE/
+GP/Sobol are fully stateless here), or (b) persist durable state in
+``experiment.algorithm_settings`` (Hyperband, mirroring the reference's
+state-in-CR round trip ``suggestionclient.go:194-196``) so an orchestrator
+restart can resume.
+"""
+
+from __future__ import annotations
+
+import abc
+import hashlib
+from typing import Callable, Type
+
+import numpy as np
+
+from katib_tpu.core.types import (
+    Experiment,
+    ExperimentSpec,
+    Trial,
+    TrialAssignmentSet,
+)
+
+
+class SuggesterError(ValueError):
+    """Invalid algorithm settings (gRPC INVALID_ARGUMENT analog)."""
+
+
+class SuggestionsNotReady(RuntimeError):
+    """The algorithm needs currently-running trials to finish before it can
+    propose more (e.g. a Hyperband rung or CMA-ES generation barrier).  The
+    orchestrator waits for a trial completion and retries — the analog of the
+    reference's controller retry on suggestion-service errors
+    (``suggestionclient.go:57-60``)."""
+
+
+class SearchExhausted(RuntimeError):
+    """The algorithm has nothing more to propose (grid fully enumerated,
+    Hyperband brackets finished).  The orchestrator completes the experiment —
+    the analog of Hyperband's empty reply when ``current_s < 0``
+    (``hyperband/service.py:47-49``)."""
+
+
+class Suggester(abc.ABC):
+    """One suggestion algorithm bound to one experiment."""
+
+    #: registry key, e.g. "random"
+    name: str = ""
+
+    def __init__(self, spec: ExperimentSpec):
+        self.spec = spec
+        self.validate(spec)
+
+    # -- contract ----------------------------------------------------------
+
+    @classmethod
+    def validate(cls, spec: ExperimentSpec) -> None:
+        """Raise SuggesterError on invalid settings/space for this algorithm."""
+
+    @abc.abstractmethod
+    def get_suggestions(
+        self, experiment: Experiment, count: int
+    ) -> list[TrialAssignmentSet]:
+        """Propose up to ``count`` new trials given the experiment's history."""
+
+    # -- shared helpers ----------------------------------------------------
+
+    def seed(self, extra: int = 0) -> int:
+        """Deterministic per-experiment seed.  ``random_state`` setting wins;
+        otherwise hash the experiment name so reruns are reproducible."""
+        s = self.spec.algorithm.setting("random_state") or self.spec.algorithm.setting(
+            "seed"
+        )
+        if s is not None:
+            return int(s) + extra
+        digest = hashlib.sha256(self.spec.name.encode()).digest()
+        return int.from_bytes(digest[:4], "little") + extra
+
+    def rng(self, extra: int = 0) -> np.random.Generator:
+        return np.random.default_rng(self.seed(extra))
+
+    @staticmethod
+    def completed_trials(experiment: Experiment) -> list[Trial]:
+        """Trials usable as observations, in start order."""
+        done = [
+            t
+            for t in experiment.trials.values()
+            if t.condition.is_completed_ok() and t.observation is not None
+        ]
+        return sorted(done, key=lambda t: t.start_time)
+
+    @staticmethod
+    def observed_xy(
+        experiment: Experiment,
+    ) -> tuple[list[dict], np.ndarray]:
+        """(params, objective values) for completed trials; values are
+        sign-flipped so that LOWER IS ALWAYS BETTER internally."""
+        obj = experiment.spec.objective
+        sign = 1.0 if obj.type.value == "minimize" else -1.0
+        xs, ys = [], []
+        for t in Suggester.completed_trials(experiment):
+            v = t.objective_value(obj)
+            if v is None:
+                continue
+            xs.append(t.params())
+            ys.append(sign * v)
+        return xs, np.asarray(ys, dtype=np.float64)
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+_REGISTRY: dict[str, Callable[[ExperimentSpec], Suggester]] = {}
+
+
+def register(name: str) -> Callable[[Type[Suggester]], Type[Suggester]]:
+    def deco(cls: Type[Suggester]) -> Type[Suggester]:
+        cls.name = name
+        _REGISTRY[name] = cls
+        return cls
+
+    return deco
+
+
+def make_suggester(spec: ExperimentSpec) -> Suggester:
+    """Instantiate the registered suggester for an experiment spec — the
+    analog of the composer resolving the algorithm image from KatibConfig
+    (``composer.go:72``)."""
+    # import for registration side effects
+    from katib_tpu.suggest import algorithms  # noqa: F401
+
+    name = spec.algorithm.name
+    if name not in _REGISTRY:
+        raise SuggesterError(
+            f"unknown algorithm {name!r}; registered: {sorted(_REGISTRY)}"
+        )
+    return _REGISTRY[name](spec)
+
+
+def registered_algorithms() -> list[str]:
+    from katib_tpu.suggest import algorithms  # noqa: F401
+
+    return sorted(_REGISTRY)

@@ -1,0 +1,101 @@
+"""Shared test helpers: synthetic experiment loop driving suggesters the way
+the orchestrator does (the in-process analog of the reference's grpc_testing
+harness, ``test/unit/v1beta1/suggestion/test_*_service.py``)."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Callable
+
+from katib_tpu.core.types import (
+    AlgorithmSpec,
+    ExperimentSpec,
+    FeasibleSpace,
+    Experiment,
+    Metric,
+    Observation,
+    ObjectiveSpec,
+    ObjectiveType,
+    ParameterSpec,
+    ParameterType,
+    Trial,
+    TrialAssignmentSet,
+    TrialCondition,
+    TrialSpec,
+)
+
+_counter = itertools.count()
+
+
+def make_spec(algorithm="random", settings=None, parameters=None, objective_type=ObjectiveType.MINIMIZE, **kw):
+    params = parameters or [
+        ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min=-5.0, max=5.0)),
+        ParameterSpec("y", ParameterType.DOUBLE, FeasibleSpace(min=-5.0, max=5.0)),
+    ]
+    defaults = dict(
+        name=kw.pop("name", f"test-exp-{next(_counter)}"),
+        objective=ObjectiveSpec(
+            type=objective_type, objective_metric_name="loss"
+        ),
+        algorithm=AlgorithmSpec(name=algorithm, settings=settings or {}),
+        parameters=params,
+        train_fn=lambda ctx: None,
+    )
+    defaults.update(kw)
+    return ExperimentSpec(**defaults)
+
+
+def complete_trial(
+    exp: Experiment,
+    proposal: TrialAssignmentSet,
+    value: float,
+    condition: TrialCondition = TrialCondition.SUCCEEDED,
+    start_time: float | None = None,
+) -> Trial:
+    """Materialize a proposal as a terminal trial with an observed objective."""
+    name = proposal.name or f"{exp.name}-t{len(exp.trials)}"
+    trial = Trial(
+        name=name,
+        experiment_name=exp.name,
+        spec=TrialSpec(
+            assignments=list(proposal.assignments),
+            labels=dict(proposal.labels),
+            early_stopping_rules=list(proposal.early_stopping_rules),
+        ),
+        condition=condition,
+        start_time=start_time if start_time is not None else float(len(exp.trials)),
+    )
+    if condition.is_completed_ok():
+        metric_name = exp.spec.objective.objective_metric_name
+        trial.observation = Observation(
+            metrics=[Metric(name=metric_name, value=value, latest=value)]
+        )
+    exp.trials[name] = trial
+    return trial
+
+
+def run_loop(
+    suggester,
+    exp: Experiment,
+    objective_fn: Callable[[dict], float],
+    rounds: int,
+    batch: int = 1,
+) -> Experiment:
+    """Ask/evaluate/tell loop: the minimal orchestrator."""
+    from katib_tpu.suggest.base import SearchExhausted, SuggestionsNotReady
+
+    for _ in range(rounds):
+        try:
+            proposals = suggester.get_suggestions(exp, batch)
+        except SearchExhausted:
+            break
+        except SuggestionsNotReady:
+            continue
+        for p in proposals:
+            complete_trial(exp, p, objective_fn(p.as_dict()))
+    return exp
+
+
+def best_value(exp: Experiment) -> float:
+    exp.update_optimal()
+    return exp.optimal.objective_value

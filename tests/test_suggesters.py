@@ -1,0 +1,531 @@
+"""Suggester algorithm tests — behavioral parity targets from the reference's
+python suggestion-service unit tests (test/unit/v1beta1/suggestion/)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from katib_tpu.core.types import (
+    Experiment,
+    FeasibleSpace,
+    ObjectiveType,
+    ParameterSpec,
+    ParameterType,
+    TrialCondition,
+)
+from katib_tpu.suggest import (
+    SearchExhausted,
+    SpaceEncoder,
+    SuggesterError,
+    SuggestionsNotReady,
+    make_suggester,
+)
+from tests.helpers import best_value, complete_trial, make_spec, run_loop
+
+sphere = lambda p: p["x"] ** 2 + p["y"] ** 2
+
+
+def new_exp(spec):
+    return Experiment(spec=spec)
+
+
+class TestSpaceEncoder:
+    def test_roundtrip_linear(self):
+        spec = make_spec()
+        enc = SpaceEncoder(spec.parameters)
+        d = {"x": 1.5, "y": -3.0}
+        assert enc.decode(enc.encode(d)) == pytest.approx({"x": 1.5, "y": -3.0})
+
+    def test_log_scaling(self):
+        from katib_tpu.core.types import Distribution
+
+        p = [
+            ParameterSpec(
+                "lr",
+                ParameterType.DOUBLE,
+                FeasibleSpace(min=1e-5, max=1e-1, distribution=Distribution.LOG_UNIFORM),
+            )
+        ]
+        enc = SpaceEncoder(p)
+        # midpoint of unit interval = geometric mean
+        assert enc.decode(np.array([0.5]))["lr"] == pytest.approx(1e-3)
+        rng = np.random.default_rng(0)
+        samples = [enc.sample(rng)["lr"] for _ in range(500)]
+        # log-uniform: about half of samples below geometric mean
+        frac_low = np.mean([s < 1e-3 for s in samples])
+        assert 0.4 < frac_low < 0.6
+
+    def test_categorical_onehot(self):
+        p = [
+            ParameterSpec("opt", ParameterType.CATEGORICAL, FeasibleSpace(list=("a", "b", "c"))),
+            ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min=0.0, max=1.0)),
+        ]
+        enc = SpaceEncoder(p)
+        v = enc.encode_onehot({"opt": "b", "x": 0.25})
+        assert v.tolist() == [0.0, 1.0, 0.0, 0.25]
+        assert enc.onehot_dims() == 4
+
+    def test_int_step_decode(self):
+        p = [ParameterSpec("n", ParameterType.INT, FeasibleSpace(min=8, max=64, step=8))]
+        enc = SpaceEncoder(p)
+        for u in np.linspace(0, 1, 17):
+            v = enc.decode(np.array([u]))["n"]
+            assert v % 8 == 0 and 8 <= v <= 64
+
+
+class TestRandom:
+    def test_in_bounds_and_deterministic(self):
+        spec = make_spec("random")
+        s1, s2 = make_suggester(spec), make_suggester(spec)
+        exp = new_exp(spec)
+        a = s1.get_suggestions(exp, 5)
+        b = s2.get_suggestions(exp, 5)
+        assert [t.as_dict() for t in a] == [t.as_dict() for t in b]
+        for t in a:
+            d = t.as_dict()
+            assert -5 <= d["x"] <= 5 and -5 <= d["y"] <= 5
+
+    def test_stream_advances_with_history(self):
+        spec = make_spec("random")
+        s = make_suggester(spec)
+        exp = new_exp(spec)
+        first = s.get_suggestions(exp, 1)[0]
+        complete_trial(exp, first, 1.0)
+        second = s.get_suggestions(exp, 1)[0]
+        assert first.as_dict() != second.as_dict()
+
+
+class TestGrid:
+    def _spec(self):
+        return make_spec(
+            "grid",
+            parameters=[
+                ParameterSpec("a", ParameterType.INT, FeasibleSpace(min=0, max=2, step=1)),
+                ParameterSpec("b", ParameterType.CATEGORICAL, FeasibleSpace(list=("u", "v"))),
+            ],
+        )
+
+    def test_enumerates_product_then_exhausts(self):
+        spec = self._spec()
+        s = make_suggester(spec)
+        exp = new_exp(spec)
+        seen = set()
+        for _ in range(3):
+            for p in s.get_suggestions(exp, 2):
+                seen.add(tuple(sorted(p.as_dict().items())))
+                complete_trial(exp, p, 0.0)
+        assert len(seen) == 6
+        with pytest.raises(SearchExhausted):
+            s.get_suggestions(exp, 1)
+
+    def test_rejects_infinite_space(self):
+        with pytest.raises(SuggesterError):
+            make_suggester(make_spec("grid"))  # doubles without step
+
+
+class TestSobol:
+    def test_low_discrepancy_and_resume(self):
+        spec = make_spec("sobol")
+        s = make_suggester(spec)
+        exp = new_exp(spec)
+        batch1 = s.get_suggestions(exp, 4)
+        for p in batch1:
+            complete_trial(exp, p, sphere(p.as_dict()))
+        batch2 = s.get_suggestions(exp, 4)
+        pts = {tuple(p.as_dict().values()) for p in batch1 + batch2}
+        assert len(pts) == 8  # stream continues, no repeats
+
+    def test_fresh_instance_continues_stream(self):
+        spec = make_spec("sobol")
+        exp = new_exp(spec)
+        b1 = make_suggester(spec).get_suggestions(exp, 2)
+        for p in b1:
+            complete_trial(exp, p, 0.0)
+        b2 = make_suggester(spec).get_suggestions(exp, 2)
+        assert {tuple(p.as_dict().values()) for p in b1}.isdisjoint(
+            {tuple(p.as_dict().values()) for p in b2}
+        )
+
+
+class TestTPE:
+    @pytest.mark.parametrize("algo", ["tpe", "multivariate-tpe"])
+    def test_beats_random_on_sphere(self, algo):
+        spec = make_spec(algo, settings={"n_startup_trials": "8", "random_state": "7"})
+        s = make_suggester(spec)
+        exp = run_loop(s, new_exp(spec), sphere, rounds=40)
+        tpe_best = best_value(exp)
+
+        rspec = make_spec("random", settings={"random_state": "7"})
+        rexp = run_loop(make_suggester(rspec), new_exp(rspec), sphere, rounds=40)
+        rand_best = best_value(rexp)
+        assert tpe_best < 1.0
+        assert tpe_best <= rand_best * 1.5  # should generally be much better
+
+    def test_categorical_dims(self):
+        spec = make_spec(
+            "tpe",
+            settings={"n_startup_trials": "5"},
+            parameters=[
+                ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min=-5.0, max=5.0)),
+                ParameterSpec("kind", ParameterType.CATEGORICAL, FeasibleSpace(list=("good", "bad"))),
+            ],
+        )
+        fn = lambda p: p["x"] ** 2 + (0.0 if p["kind"] == "good" else 10.0)
+        exp = run_loop(make_suggester(spec), new_exp(spec), fn, rounds=30)
+        exp.update_optimal()
+        chosen = dict((a.name, a.value) for a in exp.optimal.assignments)
+        assert chosen["kind"] == "good"
+
+    def test_batch_suggestions_are_distinct(self):
+        spec = make_spec("tpe", settings={"n_startup_trials": "2"})
+        s = make_suggester(spec)
+        exp = new_exp(spec)
+        for _ in range(3):
+            for p in s.get_suggestions(exp, 1):
+                complete_trial(exp, p, sphere(p.as_dict()))
+        batch = s.get_suggestions(exp, 4)
+        pts = {tuple(p.as_dict().values()) for p in batch}
+        assert len(pts) == 4
+
+    def test_settings_validation(self):
+        with pytest.raises(SuggesterError):
+            make_suggester(make_spec("tpe", settings={"gamma": "1.5"}))
+
+
+class TestBayesOpt:
+    def test_converges_on_quadratic(self):
+        spec = make_spec(
+            "bayesianoptimization",
+            settings={"n_initial_points": "6", "random_state": "3"},
+        )
+        exp = run_loop(make_suggester(spec), new_exp(spec), sphere, rounds=25)
+        assert best_value(exp) < 1.0
+
+    def test_acq_func_validation(self):
+        with pytest.raises(SuggesterError):
+            make_suggester(make_spec("bayesianoptimization", settings={"acq_func": "nope"}))
+        with pytest.raises(SuggesterError):
+            make_suggester(
+                make_spec("bayesianoptimization", settings={"base_estimator": "RF"})
+            )
+
+    def test_categorical_support(self):
+        spec = make_spec(
+            "bayesianoptimization",
+            settings={"n_initial_points": "5", "random_state": "1"},
+            parameters=[
+                ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min=-2.0, max=2.0)),
+                ParameterSpec("m", ParameterType.CATEGORICAL, FeasibleSpace(list=("p", "q"))),
+            ],
+        )
+        fn = lambda p: p["x"] ** 2 + (0 if p["m"] == "p" else 5)
+        exp = run_loop(make_suggester(spec), new_exp(spec), fn, rounds=15)
+        assert best_value(exp) < 5.0
+
+
+class TestCmaEs:
+    def test_generation_barrier_and_convergence(self):
+        spec = make_spec("cmaes", settings={"random_state": "11"})
+        s = make_suggester(spec)
+        exp = new_exp(spec)
+        # run several generations manually
+        for _ in range(12):
+            try:
+                proposals = s.get_suggestions(exp, 50)
+            except SuggestionsNotReady:
+                pytest.fail("should not block when all trials terminal")
+            for p in proposals:
+                assert "cmaes-generation" in p.labels
+                complete_trial(exp, p, sphere(p.as_dict()))
+        assert best_value(exp) < 0.5
+
+    def test_not_ready_with_pending_generation(self):
+        spec = make_spec("cmaes")
+        s = make_suggester(spec)
+        exp = new_exp(spec)
+        proposals = s.get_suggestions(exp, 50)
+        # leave them running (non-terminal)
+        for p in proposals:
+            t = complete_trial(exp, p, 0.0, condition=TrialCondition.RUNNING)
+            t.observation = None
+        with pytest.raises(SuggestionsNotReady):
+            s.get_suggestions(exp, 50)
+
+    def test_failed_member_retried_same_point(self):
+        spec = make_spec("cmaes")
+        s = make_suggester(spec)
+        exp = new_exp(spec)
+        proposals = s.get_suggestions(exp, 50)
+        failed = proposals[0]
+        complete_trial(exp, failed, 0.0, condition=TrialCondition.FAILED)
+        for p in proposals[1:]:
+            complete_trial(exp, p, sphere(p.as_dict()))
+        retry = s.get_suggestions(exp, 50)
+        assert len(retry) == 1
+        assert retry[0].labels == failed.labels
+        assert retry[0].as_dict() == pytest.approx(failed.as_dict())
+
+    def test_rejects_categorical(self):
+        with pytest.raises(SuggesterError):
+            make_suggester(
+                make_spec(
+                    "cmaes",
+                    parameters=[
+                        ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min=0, max=1)),
+                        ParameterSpec("c", ParameterType.CATEGORICAL, FeasibleSpace(list=("a",))),
+                    ],
+                )
+            )
+
+
+class TestHyperband:
+    def _spec(self, r_l=9.0, eta=3):
+        return make_spec(
+            "hyperband",
+            settings={"r_l": str(r_l), "eta": str(eta), "resource_name": "epochs"},
+            parameters=[
+                ParameterSpec("lr", ParameterType.DOUBLE, FeasibleSpace(min=0.001, max=0.1)),
+                ParameterSpec("epochs", ParameterType.INT, FeasibleSpace(min=1, max=9)),
+            ],
+            parallel_trial_count=9,
+            objective_type=ObjectiveType.MAXIMIZE,
+        )
+
+    def test_validation(self):
+        bad = self._spec()
+        object.__setattr__(bad, "parallel_trial_count", 2)
+        with pytest.raises(SuggesterError, match="parallel_trial_count"):
+            make_suggester(bad)
+        with pytest.raises(SuggesterError, match="r_l"):
+            make_suggester(make_spec("hyperband", settings={"resource_name": "x"}))
+
+    def test_bracket_progression(self):
+        spec = self._spec(r_l=9.0, eta=3)  # s_max=2: brackets s=2,1,0
+        s = make_suggester(spec)
+        exp = new_exp(spec)
+        # bracket s=2 rung 0: n0 = ceil(3*9/3) = 9 trials at resource 1
+        rung0 = s.get_suggestions(exp, 20)
+        assert len(rung0) == 9
+        assert all(p.as_dict()["epochs"] == 1 for p in rung0)
+        assert all(p.labels["hyperband-s"] == "2" for p in rung0)
+        # quality = lr (maximize): higher lr wins
+        for p in rung0:
+            complete_trial(exp, p, p.as_dict()["lr"])
+        # rung 1: top ceil(9/3)=3 promoted at resource 3
+        rung1 = s.get_suggestions(exp, 20)
+        assert len(rung1) == 3
+        assert all(p.as_dict()["epochs"] == 3 for p in rung1)
+        top_lrs = sorted(p.as_dict()["lr"] for p in rung1)
+        all_lrs = sorted((p.as_dict()["lr"] for p in rung0), reverse=True)[:3]
+        assert top_lrs == sorted(all_lrs)
+        for p in rung1:
+            complete_trial(exp, p, p.as_dict()["lr"])
+        # rung 2: top 1 at resource 9
+        rung2 = s.get_suggestions(exp, 20)
+        assert len(rung2) == 1
+        assert rung2[0].as_dict()["epochs"] == 9
+        for p in rung2:
+            complete_trial(exp, p, p.as_dict()["lr"])
+        # bracket s=1: n0 = ceil(3*3/2) = 5 at resource 3
+        b1 = s.get_suggestions(exp, 20)
+        assert len(b1) == 5
+        assert all(p.as_dict()["epochs"] == 3 for p in b1)
+
+    def test_not_ready_while_rung_running(self):
+        spec = self._spec()
+        s = make_suggester(spec)
+        exp = new_exp(spec)
+        rung0 = s.get_suggestions(exp, 20)
+        for p in rung0[:-1]:
+            complete_trial(exp, p, 1.0)
+        t = complete_trial(exp, rung0[-1], 0.0, condition=TrialCondition.RUNNING)
+        t.observation = None
+        with pytest.raises(SuggestionsNotReady):
+            s.get_suggestions(exp, 20)
+
+    def test_runs_to_exhaustion(self):
+        spec = self._spec()
+        s = make_suggester(spec)
+        exp = run_loop(s, new_exp(spec), lambda p: p["lr"], rounds=100, batch=20)
+        with pytest.raises(SearchExhausted):
+            s.get_suggestions(exp, 20)
+        # total trials = sum of all rungs over brackets
+        assert len(exp.trials) == s.total_budget()
+
+    def test_state_survives_new_instance(self):
+        spec = self._spec()
+        s = make_suggester(spec)
+        exp = new_exp(spec)
+        rung0 = s.get_suggestions(exp, 20)
+        for p in rung0:
+            complete_trial(exp, p, p.as_dict()["lr"])
+        s.get_suggestions(exp, 20)  # advances persisted state
+        fresh = make_suggester(spec)
+        rung1_again = fresh.get_suggestions(exp, 20)
+        assert all(p.labels["hyperband-i"] == "1" for p in rung1_again)
+
+
+class TestPbt(object):
+    def _spec(self, tmp_path):
+        return make_spec(
+            "pbt",
+            settings={
+                "n_population": "8",
+                "truncation_threshold": "0.25",
+                "suggestion_trial_dir": str(tmp_path),
+            },
+            objective_type=ObjectiveType.MAXIMIZE,
+        )
+
+    def test_validation(self):
+        with pytest.raises(SuggesterError, match="n_population"):
+            make_suggester(make_spec("pbt", settings={"truncation_threshold": "0.2"}))
+        with pytest.raises(SuggesterError, match=">= 5"):
+            make_suggester(
+                make_spec("pbt", settings={"n_population": "2", "truncation_threshold": "0.2"})
+            )
+
+    def test_population_lifecycle(self, tmp_path):
+        import os
+
+        spec = self._spec(tmp_path)
+        s = make_suggester(spec)
+        exp = new_exp(spec)
+        gen0 = s.get_suggestions(exp, 8)
+        assert all(p.labels["pbt-generation"] == "0" for p in gen0)
+        assert all(os.path.isdir(s.checkpoint_dir_for(p.name)) for p in gen0)
+        # score = x (maximize)
+        for p in gen0:
+            # leave a checkpoint marker behind to verify lineage copy
+            with open(os.path.join(s.checkpoint_dir_for(p.name), "ckpt.txt"), "w") as f:
+                f.write(p.name)
+            complete_trial(exp, p, p.as_dict()["x"])
+        gen1 = s.get_suggestions(exp, 8)
+        assert all(p.labels["pbt-generation"] == "1" for p in gen1)
+        assert all("pbt-parent" in p.labels for p in gen1)
+        # lineage: children inherit parent checkpoints
+        for p in gen1:
+            marker = os.path.join(s.checkpoint_dir_for(p.name), "ckpt.txt")
+            assert os.path.exists(marker)
+            with open(marker) as f:
+                assert f.read() == p.labels["pbt-parent"]
+
+    def test_exploit_clones_winner_params(self, tmp_path):
+        spec = self._spec(tmp_path)
+        s = make_suggester(spec)
+        exp = new_exp(spec)
+        gen0 = s.get_suggestions(exp, 8)
+        scores = {p.name: p.as_dict()["x"] for p in gen0}
+        for p in gen0:
+            complete_trial(exp, p, scores[p.name])
+        ranked = sorted(scores.items(), key=lambda kv: kv[1])
+        losers = {ranked[0][0], ranked[1][0]}
+        winners = {ranked[-1][0], ranked[-2][0]}
+        gen1 = s.get_suggestions(exp, 8)
+        exploit_children = [p for p in gen1 if p.labels["pbt-parent"] in winners]
+        # someone exploited a winner: params equal to a winner's params
+        assert exploit_children, "expected at least one exploit child of a top member"
+
+    def test_failed_members_requeued(self, tmp_path):
+        spec = self._spec(tmp_path)
+        s = make_suggester(spec)
+        exp = new_exp(spec)
+        gen0 = s.get_suggestions(exp, 8)
+        dead = gen0[0]
+        complete_trial(exp, dead, 0.0, condition=TrialCondition.FAILED)
+        for p in gen0[1:]:
+            complete_trial(exp, p, p.as_dict()["x"])
+        nxt = s.get_suggestions(exp, 1)[0]
+        assert nxt.as_dict() == pytest.approx(dead.as_dict())
+        assert nxt.labels["pbt-generation"] == "0"
+
+
+class TestReviewRegressions:
+    """Regression tests for defects found in review."""
+
+    def test_bayesopt_count_exceeding_startup_budget(self):
+        spec = make_spec("bayesianoptimization", settings={"n_initial_points": "2"})
+        s = make_suggester(spec)
+        exp = new_exp(spec)
+        out = s.get_suggestions(exp, 5)  # previously crashed on np.stack([])
+        assert len(out) == 5
+
+    def test_hyperband_smax_exact_power(self):
+        from katib_tpu.suggest.hyperband import _s_max
+
+        assert _s_max(1000.0, 10) == 3
+        assert _s_max(243.0, 3) == 5
+        assert _s_max(27.0, 3) == 3
+
+    def test_hyperband_eta_validation_strict(self):
+        base = dict(
+            parameters=[
+                ParameterSpec("lr", ParameterType.DOUBLE, FeasibleSpace(min=0.001, max=0.1)),
+                ParameterSpec("epochs", ParameterType.INT, FeasibleSpace(min=1, max=9)),
+            ],
+            parallel_trial_count=100,
+        )
+        for bad_eta in ("0", "0.5", "1", "abc"):
+            with pytest.raises(SuggesterError, match="eta"):
+                make_suggester(
+                    make_spec(
+                        "hyperband",
+                        settings={"r_l": "9", "resource_name": "epochs", "eta": bad_eta},
+                        **base,
+                    )
+                )
+
+    def test_hyperband_survivor_shortfall_no_deadlock(self):
+        spec = make_spec(
+            "hyperband",
+            settings={"r_l": "9", "eta": "3", "resource_name": "epochs"},
+            parameters=[
+                ParameterSpec("lr", ParameterType.DOUBLE, FeasibleSpace(min=0.001, max=0.1)),
+                ParameterSpec("epochs", ParameterType.INT, FeasibleSpace(min=1, max=9)),
+            ],
+            parallel_trial_count=9,
+            objective_type=ObjectiveType.MAXIMIZE,
+        )
+        s = make_suggester(spec)
+        exp = new_exp(spec)
+        rung0 = s.get_suggestions(exp, 20)
+        # 8 of 9 fail; only 1 survivor for a rung that nominally needs 3
+        complete_trial(exp, rung0[0], 0.9)
+        for p in rung0[1:]:
+            complete_trial(exp, p, 0.0, condition=TrialCondition.FAILED)
+        rung1 = s.get_suggestions(exp, 20)
+        assert len(rung1) == 1  # shrunk to survivor count, not empty-forever
+        complete_trial(exp, rung1[0], 0.9)
+        nxt = s.get_suggestions(exp, 20)  # advances to next rung/bracket
+        assert nxt, "must keep making progress after shrunken rung"
+
+    def test_cmaes_restart_labels_monotonic(self):
+        spec = make_spec("cmaes", settings={"restart_strategy": "ipop", "random_state": "5"})
+        s = make_suggester(spec)
+        exp = new_exp(spec)
+        # constant objective => permanent stagnation => restart fires
+        for _ in range(16):
+            try:
+                props = s.get_suggestions(exp, 50)
+            except SuggestionsNotReady:
+                pytest.fail("livelock: all trials terminal but not ready")
+            assert props, "must keep proposing after restart"
+            for p in props:
+                complete_trial(exp, p, 1.0)
+        gens = sorted({int(t.labels["cmaes-generation"]) for t in exp.trials.values()})
+        assert gens == list(range(len(gens)))  # no label reuse/collisions
+
+    def test_cmaes_missing_objective_metric_skipped(self):
+        from katib_tpu.core.types import Metric, Observation
+
+        spec = make_spec("cmaes")
+        s = make_suggester(spec)
+        exp = new_exp(spec)
+        props = s.get_suggestions(exp, 50)
+        for i, p in enumerate(props):
+            t = complete_trial(exp, p, 1.0)
+            if i == 0:  # observation lacks the objective metric entirely
+                t.observation = Observation(metrics=[Metric(name="other", value=1.0)])
+        # must not crash; the bad trial is treated as not-yet-complete
+        s.get_suggestions(exp, 50)
