@@ -337,6 +337,9 @@ class EarlyStoppingRule:
     comparison: ComparisonOp
     start_step: int = 0
 
+    def describe(self) -> str:
+        return f"rule {self.name} {self.comparison.value} {self.value}"
+
 
 # ---------------------------------------------------------------------------
 # Metrics collection
@@ -582,7 +585,9 @@ class ExperimentSpec:
     # ``experiment_defaults.go:31-44``).
     parallel_trial_count: int = 3
     max_trial_count: int | None = None
-    max_failed_trial_count: int = 0
+    # None = unlimited (reference: nil MaxFailedTrialCount never fails the
+    # experiment, ``status_util.go:204-205``)
+    max_failed_trial_count: int | None = None
     resume_policy: ResumePolicy = ResumePolicy.NEVER
     metrics_collector: MetricsCollectorSpec = field(default_factory=MetricsCollectorSpec)
     # White-box trial entry point: fn(ctx) -> None, metrics via ctx.report(...).

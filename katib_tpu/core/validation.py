@@ -114,7 +114,7 @@ def validate_experiment(spec: ExperimentSpec) -> None:
         errors.append("parallel_trial_count must be >= 1")
     if spec.max_trial_count is not None and spec.max_trial_count < 1:
         errors.append("max_trial_count must be >= 1")
-    if spec.max_failed_trial_count < 0:
+    if spec.max_failed_trial_count is not None and spec.max_failed_trial_count < 0:
         errors.append("max_failed_trial_count must be >= 0")
 
     if spec.train_fn is not None and spec.command is not None:

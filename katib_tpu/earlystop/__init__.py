@@ -1,0 +1,7 @@
+from katib_tpu.earlystop.medianstop import MedianStop  # noqa: F401
+from katib_tpu.earlystop.rules import (  # noqa: F401
+    EarlyStopper,
+    RuleEvaluator,
+    make_early_stopper,
+    register_early_stopper,
+)

@@ -1,0 +1,1 @@
+from katib_tpu.orchestrator.orchestrator import Orchestrator  # noqa: F401

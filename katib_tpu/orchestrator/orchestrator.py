@@ -1,0 +1,265 @@
+"""Experiment orchestrator — the in-process replacement for the reference's
+controller triad (experiment/suggestion/trial reconcilers,
+``pkg/controller.v1beta1/``).
+
+Where the reference coordinates through CR status updates bounced off the
+API server, this is a single event loop owning the whole experiment:
+
+- budget math: ``parallel_trial_count`` in flight, stop at
+  ``max_trial_count``, fail the experiment past ``max_failed_trial_count``
+  (reference ``experiment_controller.go:274-330`` ReconcileTrials);
+- suggestion sync: ask the suggester for exactly the shortfall
+  (reference ``suggestionclient.go:83-96`` requests - suggestionCount);
+- trial naming ``<experiment>-<rand8>`` unless the suggester names the trial
+  (PBT uids) — reference ``suggestionclient.go:171-192``;
+- early-stopping rules attached to each trial before launch (reference
+  ``suggestionclient.go:130-189``);
+- optimal-trial tracking and goal short-circuit
+  (reference ``experiment/util/status_util.go``);
+- trials run on a thread pool; JAX releases the GIL during device compute so
+  parallel trials on one host overlap host-side work with TPU steps.  A
+  multi-slice scheduler plugs in behind the same ``submit`` seam.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import os
+import secrets
+import threading
+import time
+
+from katib_tpu.core.types import (
+    Experiment,
+    ExperimentCondition,
+    ExperimentSpec,
+    ResumePolicy,
+    Trial,
+    TrialCondition,
+    TrialSpec,
+)
+from katib_tpu.core.validation import validate_experiment
+from katib_tpu.earlystop.rules import make_early_stopper
+from katib_tpu.runner.trial_runner import run_trial
+from katib_tpu.store.base import MemoryObservationStore, ObservationStore
+from katib_tpu.suggest.base import (
+    SearchExhausted,
+    SuggestionsNotReady,
+    make_suggester,
+)
+
+
+class Orchestrator:
+    def __init__(
+        self,
+        store: ObservationStore | None = None,
+        workdir: str = "katib_runs",
+        mesh=None,
+        poll_interval: float = 0.02,
+    ):
+        self.store = store if store is not None else MemoryObservationStore()
+        self.workdir = workdir
+        self.mesh = mesh
+        self.poll_interval = poll_interval
+
+    # -- public API ---------------------------------------------------------
+
+    def run(self, spec: ExperimentSpec, experiment: Experiment | None = None) -> Experiment:
+        """Run an experiment to a terminal condition; returns it with full
+        trial history and optimal-trial status.  Pass an existing
+        ``experiment`` to resume (``ResumePolicy`` semantics: a completed
+        experiment re-opens when ``max_trial_count`` was raised, reference
+        ``experiment_controller.go:187-206``)."""
+        validate_experiment(spec)
+        exp = experiment or Experiment(spec=spec)
+        if experiment is not None:
+            exp.spec = spec
+            if exp.condition.is_terminal():
+                if spec.resume_policy is ResumePolicy.NEVER:
+                    raise RuntimeError(
+                        f"experiment {exp.name} is terminal and resume_policy=Never"
+                    )
+                exp.condition = ExperimentCondition.RESTARTING
+                exp.completion_time = 0.0
+
+        suggester = make_suggester(spec)
+        early_stopper = make_early_stopper(spec)
+        if early_stopper is not None and hasattr(early_stopper, "bind_store"):
+            early_stopper.bind_store(self.store)
+
+        exp.condition = ExperimentCondition.RUNNING
+        exhausted = False
+        stalled_polls = 0
+        futures: dict[cf.Future, Trial] = {}
+        # signals in-flight trials to wind down once the experiment is decided
+        # (the reference deletes running trial jobs, experiment_controller.go:362)
+        stop_event = threading.Event()
+        self._stop_event = stop_event
+
+        with cf.ThreadPoolExecutor(
+            max_workers=spec.parallel_trial_count, thread_name_prefix=f"trial-{exp.name}"
+        ) as pool:
+            while True:
+                self._harvest(exp, futures)
+                verdict = self._check_terminal(exp, exhausted, futures)
+                if verdict is not None:
+                    stop_event.set()
+                    self._cancel_pending(futures)
+                    self._harvest(exp, futures, wait_running=True)
+                    exp.condition = verdict
+                    exp.completion_time = time.time()
+                    exp.update_optimal()
+                    exp.message = self._terminal_message(verdict)
+                    return exp
+
+                want = self._shortfall(exp, futures)
+                proposals = []
+                if want > 0 and not exhausted:
+                    try:
+                        proposals = suggester.get_suggestions(exp, want)
+                    except SearchExhausted:
+                        exhausted = True
+                    except SuggestionsNotReady:
+                        pass
+                    for proposal in proposals:
+                        trial = self._materialize(exp, proposal, early_stopper, suggester)
+                        futures[pool.submit(self._execute, exp, trial)] = trial
+
+                # livelock guard: nothing running, nothing proposed, not
+                # exhausted — a buggy suggester would spin here forever
+                if not futures and not proposals and not exhausted:
+                    stalled_polls += 1
+                    if stalled_polls * self.poll_interval > 30.0:
+                        exp.condition = ExperimentCondition.FAILED
+                        exp.message = (
+                            "orchestrator stalled: suggester proposes nothing "
+                            "with no trials in flight"
+                        )
+                        exp.completion_time = time.time()
+                        exp.update_optimal()
+                        return exp
+                else:
+                    stalled_polls = 0
+                time.sleep(self.poll_interval)
+
+    # -- internals ----------------------------------------------------------
+
+    def _materialize(self, exp: Experiment, proposal, early_stopper, suggester) -> Trial:
+        name = proposal.name or f"{exp.name}-{secrets.token_hex(4)}"
+        rules = list(proposal.early_stopping_rules)
+        if early_stopper is not None and not rules:
+            rules = early_stopper.get_rules(exp)
+        # PBT pre-populates lineage checkpoints in its own directory layout
+        if hasattr(suggester, "checkpoint_dir_for"):
+            ckpt = suggester.checkpoint_dir_for(name)
+        else:
+            ckpt = os.path.join(self.workdir, exp.name, name)
+        trial = Trial(
+            name=name,
+            experiment_name=exp.name,
+            spec=TrialSpec(
+                assignments=list(proposal.assignments),
+                early_stopping_rules=rules,
+                labels=dict(proposal.labels),
+                train_fn=exp.spec.train_fn,
+                command=list(exp.spec.command) if exp.spec.command else None,
+                metrics_collector=exp.spec.metrics_collector,
+            ),
+            condition=TrialCondition.RUNNING,
+            start_time=time.time(),
+            checkpoint_dir=ckpt,
+        )
+        exp.trials[name] = trial
+        return trial
+
+    def _execute(self, exp: Experiment, trial: Trial):
+        return run_trial(
+            trial,
+            self.store,
+            exp.spec.objective,
+            mesh=self.mesh,
+            stop_event=self._stop_event,
+        )
+
+    def _harvest(
+        self, exp: Experiment, futures: dict, wait_running: bool = False
+    ) -> None:
+        done = [f for f in futures if f.done()]
+        if wait_running and futures:
+            done = list(cf.wait(list(futures)).done)
+        for f in done:
+            trial = futures.pop(f)
+            if f.cancelled():
+                trial.condition = TrialCondition.KILLED
+                trial.completion_time = time.time()
+                continue
+            result = f.result()  # _execute never raises
+            trial.condition = result.condition
+            trial.message = result.message
+            trial.completion_time = time.time()
+            if trial.condition in (
+                TrialCondition.SUCCEEDED,
+                TrialCondition.EARLY_STOPPED,
+            ):
+                trial.observation = self.store.observation_for(
+                    trial.name, exp.spec.objective
+                )
+                if trial.observation is None:
+                    trial.condition = TrialCondition.METRICS_UNAVAILABLE
+            exp.update_optimal()
+
+    @staticmethod
+    def _budget_used(exp: Experiment) -> int:
+        """Terminal trials of every kind consume the budget — the reference
+        counts succeeded + failed + killed + early-stopped as completed
+        (``experiment_controller.go:280-281``)."""
+        return sum(1 for t in exp.trials.values() if t.condition.is_terminal())
+
+    def _shortfall(self, exp: Experiment, futures: dict) -> int:
+        """Reference budget math (``experiment_controller.go:274-330``):
+        keep ``parallel_trial_count`` active, never exceed ``max_trial_count``
+        counting every terminal trial plus the ones in flight."""
+        spec = exp.spec
+        active = len(futures)
+        slots = spec.parallel_trial_count - active
+        if spec.max_trial_count is not None:
+            slots = min(slots, spec.max_trial_count - self._budget_used(exp) - active)
+        return max(0, slots)
+
+    def _check_terminal(
+        self, exp: Experiment, exhausted: bool, futures: dict
+    ) -> ExperimentCondition | None:
+        spec = exp.spec
+        if (
+            spec.max_failed_trial_count is not None
+            and exp.failed_count > 0
+            and exp.failed_count >= spec.max_failed_trial_count
+        ):
+            return ExperimentCondition.FAILED
+        exp.update_optimal()
+        if exp.optimal is not None and spec.objective.is_goal_reached(
+            exp.optimal.objective_value
+        ):
+            return ExperimentCondition.GOAL_REACHED
+        if (
+            spec.max_trial_count is not None
+            and self._budget_used(exp) >= spec.max_trial_count
+        ):
+            return ExperimentCondition.MAX_TRIALS_REACHED
+        if exhausted and not futures:
+            return ExperimentCondition.SUCCEEDED
+        return None
+
+    @staticmethod
+    def _terminal_message(cond: ExperimentCondition) -> str:
+        return {
+            ExperimentCondition.GOAL_REACHED: "objective goal reached",
+            ExperimentCondition.MAX_TRIALS_REACHED: "max trial count reached",
+            ExperimentCondition.FAILED: "max failed trial count exceeded",
+            ExperimentCondition.SUCCEEDED: "search space exhausted",
+        }.get(cond, "")
+
+    @staticmethod
+    def _cancel_pending(futures: dict) -> None:
+        for f in futures:
+            f.cancel()

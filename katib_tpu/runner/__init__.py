@@ -1,0 +1,2 @@
+from katib_tpu.runner.context import TrialContext, TrialEarlyStopped  # noqa: F401
+from katib_tpu.runner.trial_runner import TrialResult, run_trial  # noqa: F401

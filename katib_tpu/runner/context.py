@@ -1,0 +1,102 @@
+"""Trial execution context — the white-box replacement for the reference's
+pod machinery.
+
+In the reference, a trial is an opaque container: parameters arrive as CLI
+args rendered from a template (``manifest/generator.go:79-99``), metrics leave
+via stdout scraping by an injected sidecar (``pod/inject_webhook.go:123``),
+and early stopping is a SIGTERM from that sidecar.  Here a trial is a
+function ``train_fn(ctx)`` and ``TrialContext`` is its whole contract:
+
+- ``ctx.params``           — suggested hyperparameters (typed, not strings)
+- ``ctx.report(...)``      — metrics straight into the observation store
+- ``ctx.should_stop()``    — cooperative early-stopping check
+- ``ctx.checkpoint_dir``   — per-trial checkpoint directory (PBT lineage
+                             pre-populated by the suggester)
+- ``ctx.mesh``             — the JAX device mesh the trial should train on
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Mapping
+
+from katib_tpu.core.types import MetricLog
+from katib_tpu.earlystop.rules import RuleEvaluator
+from katib_tpu.store.base import ObservationStore
+
+
+class TrialEarlyStopped(Exception):
+    """Raised by ``report(..., check_stop=True)`` / ``raise_if_stopped`` to
+    unwind a training loop when a stop rule fires."""
+
+
+class TrialContext:
+    def __init__(
+        self,
+        trial_name: str,
+        params: Mapping[str, Any],
+        store: ObservationStore,
+        evaluator: RuleEvaluator | None = None,
+        checkpoint_dir: str | None = None,
+        mesh: Any = None,
+        labels: Mapping[str, str] | None = None,
+        stop_event: Any = None,
+    ):
+        self.trial_name = trial_name
+        self.params = dict(params)
+        self._store = store
+        self._evaluator = evaluator
+        self.checkpoint_dir = checkpoint_dir
+        self.mesh = mesh
+        self.labels = dict(labels or {})
+        self._stop_event = stop_event
+        self._step = 0
+
+    # -- metrics -----------------------------------------------------------
+
+    def report(self, step: int | None = None, **metrics: float) -> bool:
+        """Report metric values; returns True while the trial may continue.
+
+        ``ctx.report(accuracy=0.91, loss=0.3, step=epoch)`` replaces the
+        reference's ``print("accuracy=0.91")`` + sidecar regex scrape.
+        """
+        if step is None:
+            step = self._step
+            self._step += 1
+        else:
+            self._step = step + 1
+        now = time.time()
+        logs = [
+            MetricLog(metric_name=k, value=float(v), timestamp=now, step=step)
+            for k, v in metrics.items()
+        ]
+        self._store.report(self.trial_name, logs)
+        if self._evaluator is not None:
+            for log in logs:
+                self._evaluator.observe(log.metric_name, log.value)
+        return not self.should_stop()
+
+    # -- early stopping ------------------------------------------------------
+
+    def should_stop(self) -> bool:
+        """True when an early-stopping rule fired OR the experiment reached a
+        terminal state (goal hit / failure budget) and wants trials to wind
+        down."""
+        if self._evaluator is not None and self._evaluator.should_stop():
+            return True
+        return self._stop_event is not None and self._stop_event.is_set()
+
+    def raise_if_stopped(self) -> None:
+        if self._evaluator is not None and self._evaluator.should_stop():
+            raise TrialEarlyStopped(self._evaluator.triggered.describe())
+        if self._stop_event is not None and self._stop_event.is_set():
+            raise TrialEarlyStopped("experiment reached terminal state")
+
+    # -- checkpoints ---------------------------------------------------------
+
+    def ensure_checkpoint_dir(self) -> str:
+        if self.checkpoint_dir is None:
+            raise RuntimeError("trial has no checkpoint directory configured")
+        os.makedirs(self.checkpoint_dir, exist_ok=True)
+        return self.checkpoint_dir

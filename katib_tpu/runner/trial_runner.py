@@ -1,0 +1,258 @@
+"""Trial runners: white-box (Python/JAX function) and black-box (subprocess).
+
+The white-box path collapses the reference's trial pipeline (trial controller
+creates Job -> pod webhook injects sidecar -> sidecar PNS-waits and scrapes
+stdout -> gRPC to DB-manager -> controller polls observation,
+``trial_controller.go:147-306`` + ``inject_webhook.go`` + ``pns.go``) into a
+function call with a metrics callback.
+
+The black-box path keeps parity with arbitrary-language trials: the command
+template's ``${trialParameters.X}`` placeholders are substituted
+(``manifest/generator.go:99``), metrics are scraped live — from stdout for
+StdOut collectors, by tailing the metrics file for File/JsonLines collectors
+(the sidecar's watch loop, ``file-metricscollector/main.go:143``) — and
+early-stopping rules terminate the process on trigger (the sidecar's SIGTERM
+dance, ``main.go:262-306``).
+
+Both paths honor a shared ``stop_event``: when the orchestrator reaches a
+terminal verdict (goal hit, failure budget blown) it sets the event and
+in-flight trials wind down as ``Killed`` (the reference deletes running trial
+jobs on experiment completion, ``experiment_controller.go:362-403``).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+import time
+import traceback
+
+from katib_tpu.core.types import (
+    MetricsCollectorKind,
+    Trial,
+    TrialCondition,
+)
+from katib_tpu.earlystop.rules import RuleEvaluator
+from katib_tpu.runner.context import TrialContext, TrialEarlyStopped
+from katib_tpu.runner.metrics import parse_json_lines, parse_text_lines
+from katib_tpu.store.base import ObservationStore
+
+
+class TrialResult:
+    def __init__(self, condition: TrialCondition, message: str = ""):
+        self.condition = condition
+        self.message = message
+
+
+def run_trial(
+    trial: Trial,
+    store: ObservationStore,
+    objective,
+    mesh=None,
+    stop_event: threading.Event | None = None,
+) -> TrialResult:
+    """Execute one trial to a terminal condition.  Never raises: failures
+    become ``TrialCondition.FAILED`` with the traceback in ``message``
+    (budget accounting needs failed trials recorded, not exceptions —
+    reference ``experiment_controller.go:274-330``)."""
+    evaluator = RuleEvaluator(trial.spec.early_stopping_rules, objective)
+    try:
+        if trial.spec.train_fn is not None:
+            return _run_whitebox(trial, store, evaluator, objective, mesh, stop_event)
+        if trial.spec.command:
+            return _run_blackbox(trial, store, evaluator, objective, stop_event)
+        return TrialResult(
+            TrialCondition.FAILED, "trial has neither train_fn nor command"
+        )
+    except Exception:
+        return TrialResult(TrialCondition.FAILED, traceback.format_exc(limit=20))
+
+
+def _finalize(trial: Trial, store: ObservationStore, objective) -> TrialResult:
+    """Post-run observation check: succeeded-but-no-objective-metric becomes
+    MetricsUnavailable (reference ``newObservationLog`` +
+    ``trial_controller.go:249-252``)."""
+    obs = store.observation_for(trial.name, objective)
+    if obs is None:
+        return TrialResult(
+            TrialCondition.METRICS_UNAVAILABLE,
+            f"objective metric {objective.objective_metric_name!r} was never reported",
+        )
+    return TrialResult(TrialCondition.SUCCEEDED)
+
+
+def _run_whitebox(
+    trial: Trial,
+    store: ObservationStore,
+    evaluator: RuleEvaluator,
+    objective,
+    mesh,
+    stop_event: threading.Event | None,
+) -> TrialResult:
+    ctx = TrialContext(
+        trial_name=trial.name,
+        params=trial.params(),
+        store=store,
+        evaluator=evaluator,
+        checkpoint_dir=trial.checkpoint_dir,
+        mesh=mesh,
+        labels=trial.spec.labels,
+        stop_event=stop_event,
+    )
+    try:
+        trial.spec.train_fn(ctx)
+    except TrialEarlyStopped as e:
+        if evaluator.triggered is None:
+            return TrialResult(TrialCondition.KILLED, str(e))
+        return TrialResult(TrialCondition.EARLY_STOPPED, str(e))
+    except Exception:
+        return TrialResult(TrialCondition.FAILED, traceback.format_exc(limit=20))
+    if evaluator.should_stop():
+        return TrialResult(TrialCondition.EARLY_STOPPED, evaluator.triggered.describe())
+    if stop_event is not None and stop_event.is_set():
+        return TrialResult(TrialCondition.KILLED, "experiment reached terminal state")
+    return _finalize(trial, store, objective)
+
+
+def substitute_command(command: list[str], params: dict) -> list[str]:
+    """Render ``${trialParameters.X}`` placeholders (reference
+    ``manifest/generator.go:99`` applyParameters)."""
+    out = []
+    for arg in command:
+        for name, value in params.items():
+            arg = arg.replace("${trialParameters.%s}" % name, str(value))
+        out.append(arg)
+    return out
+
+
+class _LineSource:
+    """Incremental metric-line source for a running black-box trial."""
+
+    def poll(self) -> list[str]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class _StdoutSource(_LineSource):
+    """Drains the process's stdout on a reader thread (never blocks poll)."""
+
+    def __init__(self, proc: subprocess.Popen):
+        self._lines: list[str] = []
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._drain, args=(proc,), daemon=True)
+        self._thread.start()
+
+    def _drain(self, proc: subprocess.Popen) -> None:
+        assert proc.stdout is not None
+        for line in proc.stdout:
+            with self._lock:
+                self._lines.append(line)
+
+    def poll(self) -> list[str]:
+        with self._lock:
+            out, self._lines = self._lines, []
+        return out
+
+
+class _FileTailSource(_LineSource):
+    """Tails the metrics file the trial writes (sidecar watch parity,
+    ``file-metricscollector/main.go:143``)."""
+
+    def __init__(self, path: str):
+        self._path = path
+        self._offset = 0
+        self._buffer = ""
+
+    def poll(self) -> list[str]:
+        if not os.path.exists(self._path):
+            return []
+        try:
+            with open(self._path, errors="replace") as f:
+                f.seek(self._offset)
+                chunk = f.read()
+                self._offset = f.tell()
+        except OSError:
+            return []
+        self._buffer += chunk
+        if "\n" not in self._buffer:
+            return []
+        *complete, self._buffer = self._buffer.split("\n")
+        return complete
+
+
+def _run_blackbox(
+    trial: Trial,
+    store: ObservationStore,
+    evaluator: RuleEvaluator,
+    objective,
+    stop_event: threading.Event | None,
+) -> TrialResult:
+    collector = trial.spec.metrics_collector
+    metric_names = list(objective.all_metric_names())
+    argv = substitute_command(trial.spec.command, trial.params())
+    filters = [collector.filter] if collector.filter else []
+    use_file = collector.path and collector.kind in (
+        MetricsCollectorKind.FILE,
+        MetricsCollectorKind.JSONL,
+    )
+
+    def parse(lines: list[str]):
+        try:
+            if collector.kind is MetricsCollectorKind.JSONL:
+                return parse_json_lines(lines, metric_names)
+            return parse_text_lines(lines, metric_names, filters)
+        except ValueError:
+            return []
+
+    try:
+        proc = subprocess.Popen(
+            argv,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            errors="replace",
+            bufsize=1,
+        )
+    except OSError as e:
+        return TrialResult(TrialCondition.FAILED, f"failed to launch {argv[0]}: {e}")
+
+    # metrics come from exactly one source: the file when configured, else
+    # stdout (no double-reporting); stdout is always drained to avoid blocking
+    stdout_source = _StdoutSource(proc)
+    source: _LineSource = _FileTailSource(collector.path) if use_file else stdout_source
+
+    early_stopped = False
+    killed = False
+    terminate_at: float | None = None
+    while True:
+        for log in parse(source.poll()):
+            store.report(trial.name, [log])
+            if evaluator.observe(log.metric_name, log.value):
+                early_stopped = True
+        if stop_event is not None and stop_event.is_set():
+            killed = True
+        if (early_stopped or killed) and terminate_at is None:
+            proc.terminate()
+            terminate_at = time.monotonic()
+        if terminate_at is not None and time.monotonic() - terminate_at > 10.0:
+            proc.kill()  # SIGTERM ignored; escalate (classification unchanged)
+            terminate_at = float("inf")
+        if proc.poll() is not None:
+            break
+        time.sleep(0.05)
+    rc = proc.wait()
+
+    # final sweep for lines written right before exit
+    for log in parse(source.poll()):
+        store.report(trial.name, [log])
+
+    if early_stopped:
+        return TrialResult(TrialCondition.EARLY_STOPPED, evaluator.triggered.describe())
+    if killed:
+        return TrialResult(TrialCondition.KILLED, "experiment reached terminal state")
+    if rc != 0:
+        return TrialResult(TrialCondition.FAILED, f"exit code {rc}")
+    return _finalize(trial, store, objective)

@@ -1,0 +1,434 @@
+"""Trial runner + orchestrator + early-stopping tests.
+
+The e2e tests mirror the invariants the reference asserts in its e2e runner
+(``run-e2e-experiment.py:52-60``): best objective exists, and
+MaxTrialsReached implies completed == max_trial_count.
+"""
+
+import sys
+import time
+
+import pytest
+
+from katib_tpu.core.types import (
+    AlgorithmSpec,
+    ComparisonOp,
+    EarlyStoppingRule,
+    EarlyStoppingSpec,
+    ExperimentCondition,
+    ExperimentSpec,
+    FeasibleSpace,
+    MetricsCollectorKind,
+    MetricsCollectorSpec,
+    ObjectiveSpec,
+    ObjectiveType,
+    ParameterSpec,
+    ParameterType,
+    Trial,
+    TrialCondition,
+    TrialSpec,
+)
+from katib_tpu.earlystop.rules import RuleEvaluator
+from katib_tpu.orchestrator import Orchestrator
+from katib_tpu.runner.trial_runner import run_trial, substitute_command
+from katib_tpu.store.base import MemoryObservationStore
+
+OBJ = ObjectiveSpec(type=ObjectiveType.MAXIMIZE, objective_metric_name="accuracy")
+
+
+def quadratic_trainer(ctx):
+    """accuracy peaks at x=2, improves over 5 steps."""
+    x = ctx.params["x"]
+    final = 1.0 - 0.1 * (x - 2.0) ** 2
+    for step in range(5):
+        if not ctx.report(accuracy=final * (step + 1) / 5, step=step):
+            return
+    ctx.report(accuracy=final, step=5)
+
+
+def make_spec(**kw):
+    defaults = dict(
+        name=kw.pop("name", f"exp-{time.time_ns()}"),
+        objective=OBJ,
+        algorithm=AlgorithmSpec(name="random"),
+        parameters=[
+            ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min=-4.0, max=4.0)),
+        ],
+        train_fn=quadratic_trainer,
+        parallel_trial_count=3,
+        max_trial_count=12,
+    )
+    defaults.update(kw)
+    return ExperimentSpec(**defaults)
+
+
+class TestRuleEvaluator:
+    def test_start_step_gate(self):
+        ev = RuleEvaluator(
+            [EarlyStoppingRule("accuracy", 0.5, ComparisonOp.LESS, start_step=3)], OBJ
+        )
+        assert not ev.observe("accuracy", 0.1)
+        assert not ev.observe("accuracy", 0.1)
+        assert ev.observe("accuracy", 0.1)  # third report, below bar
+
+    def test_best_so_far_for_objective(self):
+        ev = RuleEvaluator(
+            [EarlyStoppingRule("accuracy", 0.5, ComparisonOp.LESS, start_step=1)], OBJ
+        )
+        assert not ev.observe("accuracy", 0.9)  # best = 0.9
+        # dip below bar, but best-so-far 0.9 is not < 0.5 -> no stop
+        assert not ev.observe("accuracy", 0.1)
+
+    def test_non_objective_uses_latest(self):
+        ev = RuleEvaluator(
+            [EarlyStoppingRule("loss", 10.0, ComparisonOp.GREATER, start_step=1)], OBJ
+        )
+        assert not ev.observe("loss", 5.0)
+        assert ev.observe("loss", 20.0)
+
+
+class TestWhiteboxRunner:
+    def _trial(self, fn, rules=()):
+        from katib_tpu.core.types import ParameterAssignment
+
+        return Trial(
+            name="t1",
+            spec=TrialSpec(
+                assignments=[ParameterAssignment("x", 1.0)],
+                train_fn=fn,
+                early_stopping_rules=list(rules),
+            ),
+        )
+
+    def test_success_path(self):
+        store = MemoryObservationStore()
+        res = run_trial(self._trial(quadratic_trainer), store, OBJ)
+        assert res.condition is TrialCondition.SUCCEEDED
+        assert store.get("t1", "accuracy")
+
+    def test_failure_captured(self):
+        store = MemoryObservationStore()
+        res = run_trial(self._trial(lambda ctx: 1 / 0), store, OBJ)
+        assert res.condition is TrialCondition.FAILED
+        assert "ZeroDivisionError" in res.message
+
+    def test_metrics_unavailable(self):
+        store = MemoryObservationStore()
+        res = run_trial(self._trial(lambda ctx: None), store, OBJ)
+        assert res.condition is TrialCondition.METRICS_UNAVAILABLE
+
+    def test_cooperative_early_stop(self):
+        store = MemoryObservationStore()
+        rules = [EarlyStoppingRule("accuracy", 0.9, ComparisonOp.LESS, start_step=2)]
+        steps_done = []
+
+        def trainer(ctx):
+            for step in range(100):
+                steps_done.append(step)
+                if not ctx.report(accuracy=0.1, step=step):
+                    return
+
+        res = run_trial(self._trial(trainer, rules), store, OBJ)
+        assert res.condition is TrialCondition.EARLY_STOPPED
+        assert len(steps_done) == 2  # stopped at start_step, not 100
+
+    def test_raise_if_stopped(self):
+        store = MemoryObservationStore()
+        rules = [EarlyStoppingRule("accuracy", 0.9, ComparisonOp.LESS, start_step=1)]
+
+        def trainer(ctx):
+            ctx.report(accuracy=0.1)
+            ctx.raise_if_stopped()
+            raise AssertionError("unreachable")
+
+        res = run_trial(self._trial(trainer, rules), store, OBJ)
+        assert res.condition is TrialCondition.EARLY_STOPPED
+
+
+class TestBlackboxRunner:
+    def test_substitution(self):
+        argv = substitute_command(
+            ["python", "train.py", "--lr=${trialParameters.lr}", "--u=${trialParameters.units}"],
+            {"lr": 0.01, "units": 32},
+        )
+        assert argv == ["python", "train.py", "--lr=0.01", "--u=32"]
+
+    def _script_trial(self, code, params=None, rules=()):
+        return Trial(
+            name="bb1",
+            spec=TrialSpec(
+                command=["python", "-u", "-c", code],
+                assignments=[],
+                early_stopping_rules=list(rules),
+                metrics_collector=MetricsCollectorSpec(kind=MetricsCollectorKind.STDOUT),
+            ),
+        )
+
+    def test_stdout_collection(self):
+        store = MemoryObservationStore()
+        code = "print('accuracy=0.5'); print('accuracy=0.75')"
+        res = run_trial(self._script_trial(code), store, OBJ)
+        assert res.condition is TrialCondition.SUCCEEDED
+        assert [l.value for l in store.get("bb1", "accuracy")] == [0.5, 0.75]
+
+    def test_nonzero_exit_fails(self):
+        store = MemoryObservationStore()
+        res = run_trial(self._script_trial("raise SystemExit(3)"), store, OBJ)
+        assert res.condition is TrialCondition.FAILED
+        assert "exit code 3" in res.message
+
+    def test_no_metrics_unavailable(self):
+        store = MemoryObservationStore()
+        res = run_trial(self._script_trial("print('hello')"), store, OBJ)
+        assert res.condition is TrialCondition.METRICS_UNAVAILABLE
+
+    def test_early_stop_terminates_process(self):
+        store = MemoryObservationStore()
+        code = (
+            "import time\n"
+            "for i in range(100):\n"
+            "    print(f'accuracy=0.01')\n"
+            "    time.sleep(0.05)\n"
+        )
+        rules = [EarlyStoppingRule("accuracy", 0.5, ComparisonOp.LESS, start_step=2)]
+        t0 = time.time()
+        res = run_trial(self._script_trial(code, rules=rules), store, OBJ)
+        assert res.condition is TrialCondition.EARLY_STOPPED
+        assert time.time() - t0 < 4.0  # killed long before 5s of sleeps
+
+
+class TestOrchestrator:
+    def test_max_trials_reached_invariant(self):
+        orch = Orchestrator()
+        exp = orch.run(make_spec(max_trial_count=8, parallel_trial_count=4))
+        # reference e2e invariant: MaxTrialsReached => completed == max
+        assert exp.condition is ExperimentCondition.MAX_TRIALS_REACHED
+        assert exp.completed_count == 8
+        assert exp.optimal is not None
+        assert exp.optimal.objective_value <= 1.0
+
+    def test_goal_short_circuits(self):
+        spec = make_spec(
+            objective=ObjectiveSpec(
+                type=ObjectiveType.MAXIMIZE,
+                objective_metric_name="accuracy",
+                goal=0.2,
+            ),
+            max_trial_count=50,
+        )
+        orch = Orchestrator()
+        exp = orch.run(spec)
+        assert exp.condition is ExperimentCondition.GOAL_REACHED
+        assert exp.optimal.objective_value >= 0.2
+        assert len(exp.trials) < 50
+
+    def test_failure_budget(self):
+        def bad_trainer(ctx):
+            raise RuntimeError("boom")
+
+        spec = make_spec(
+            train_fn=bad_trainer, max_trial_count=30, max_failed_trial_count=3
+        )
+        exp = Orchestrator().run(spec)
+        assert exp.condition is ExperimentCondition.FAILED
+        # reference semantics: fails as soon as failed >= max (status_util.go:205)
+        assert exp.failed_count >= 3
+
+    def test_grid_exhaustion_completes(self):
+        spec = make_spec(
+            algorithm=AlgorithmSpec(name="grid"),
+            parameters=[
+                ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min=0.0, max=4.0, step=1.0)),
+            ],
+            max_trial_count=None,
+        )
+        exp = Orchestrator().run(spec)
+        assert exp.condition is ExperimentCondition.SUCCEEDED
+        assert len(exp.trials) == 5
+        # grid best is x=2.0 exactly
+        assert exp.optimal.objective_value == pytest.approx(1.0)
+
+    def test_parallelism_bounded(self):
+        import threading
+
+        live = []
+        peak = []
+        lock = threading.Lock()
+
+        def trainer(ctx):
+            with lock:
+                live.append(1)
+                peak.append(len(live))
+            time.sleep(0.05)
+            ctx.report(accuracy=0.5)
+            with lock:
+                live.pop()
+
+        spec = make_spec(train_fn=trainer, parallel_trial_count=2, max_trial_count=6)
+        Orchestrator().run(spec)
+        assert max(peak) <= 2
+
+    def test_trial_names_follow_convention(self):
+        exp = Orchestrator().run(make_spec(max_trial_count=3))
+        for name in exp.trials:
+            assert name.startswith(exp.name + "-")
+
+    def test_resume_after_max_trials_raised(self):
+        spec = make_spec(max_trial_count=4, resume_policy="LongRunning")
+        orch = Orchestrator()
+        exp = orch.run(spec)
+        assert exp.completed_count == 4
+        import dataclasses
+
+        spec2 = dataclasses.replace(spec, max_trial_count=8)
+        exp2 = orch.run(spec2, experiment=exp)
+        assert exp2.completed_count == 8
+        assert exp2.condition is ExperimentCondition.MAX_TRIALS_REACHED
+
+    def test_resume_never_policy_rejected(self):
+        spec = make_spec(max_trial_count=2)
+        orch = Orchestrator()
+        exp = orch.run(spec)
+        with pytest.raises(RuntimeError, match="Never"):
+            orch.run(spec, experiment=exp)
+
+
+class TestMedianStopIntegration:
+    def test_bad_trials_get_stopped(self):
+        # trainer quality depends on x; bad x trials report low accuracy
+        # from the start and should be median-stopped
+        def trainer(ctx):
+            good = ctx.params["x"] > 0
+            for step in range(8):
+                acc = (0.8 if good else 0.1) * (step + 1) / 8
+                if not ctx.report(accuracy=acc, step=step):
+                    return
+
+        spec = make_spec(
+            train_fn=trainer,
+            parameters=[
+                ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min=-1.0, max=1.0)),
+            ],
+            early_stopping=EarlyStoppingSpec(
+                name="medianstop",
+                settings={"min_trials_required": "3", "start_step": "4"},
+            ),
+            max_trial_count=14,
+            parallel_trial_count=2,
+        )
+        exp = Orchestrator().run(spec)
+        assert exp.condition is ExperimentCondition.MAX_TRIALS_REACHED
+        stopped = exp.early_stopped_count
+        # with half the space bad, some trials must get early-stopped
+        assert stopped >= 1
+        # early-stopped trials count toward completion (reference parity)
+        assert exp.completed_count == 14
+
+
+class TestExecutionRegressions:
+    """Regressions for review findings on the execution core."""
+
+    def test_always_failing_trainer_terminates_without_cap(self):
+        # no max_failed_trial_count: failed trials must still consume the
+        # max_trial_count budget so the experiment ends
+        def bad(ctx):
+            raise RuntimeError("boom")
+
+        spec = make_spec(train_fn=bad, max_trial_count=6, parallel_trial_count=2)
+        t0 = time.time()
+        exp = Orchestrator().run(spec)
+        assert time.time() - t0 < 20
+        assert exp.condition is ExperimentCondition.MAX_TRIALS_REACHED
+        assert exp.failed_count == 6
+
+    def test_blackbox_never_raises_on_binary_stdout(self):
+        store = MemoryObservationStore()
+        trial = Trial(
+            name="bin1",
+            spec=TrialSpec(
+                command=[
+                    "python",
+                    "-c",
+                    "import sys; sys.stdout.buffer.write(b'\\xff\\xfe garbage\\naccuracy=0.5\\n')",
+                ],
+                metrics_collector=MetricsCollectorSpec(kind=MetricsCollectorKind.STDOUT),
+            ),
+        )
+        res = run_trial(trial, store, OBJ)
+        assert res.condition is TrialCondition.SUCCEEDED
+        assert [l.value for l in store.get("bin1", "accuracy")] == [0.5]
+
+    def test_file_collector_tails_live_and_early_stops(self, tmp_path):
+        path = str(tmp_path / "metrics.log")
+        code = (
+            "import time\n"
+            f"f = open({path!r}, 'w', buffering=1)\n"
+            "for i in range(100):\n"
+            "    f.write('accuracy=0.01\\n')\n"
+            "    time.sleep(0.05)\n"
+        )
+        trial = Trial(
+            name="ft1",
+            spec=TrialSpec(
+                command=["python", "-u", "-c", code],
+                early_stopping_rules=[
+                    EarlyStoppingRule("accuracy", 0.5, ComparisonOp.LESS, start_step=2)
+                ],
+                metrics_collector=MetricsCollectorSpec(
+                    kind=MetricsCollectorKind.FILE, path=path
+                ),
+            ),
+        )
+        store = MemoryObservationStore()
+        t0 = time.time()
+        res = run_trial(trial, store, OBJ)
+        assert res.condition is TrialCondition.EARLY_STOPPED
+        assert time.time() - t0 < 4.0  # live tail, not end-of-run parse
+
+    def test_file_collector_no_double_report(self, tmp_path):
+        path = str(tmp_path / "m.log")
+        code = (
+            f"open({path!r}, 'w').write('accuracy=0.7\\n')\n"
+            "print('accuracy=0.7')\n"  # same metric echoed to stdout
+        )
+        trial = Trial(
+            name="fd1",
+            spec=TrialSpec(
+                command=["python", "-c", code],
+                metrics_collector=MetricsCollectorSpec(
+                    kind=MetricsCollectorKind.FILE, path=path
+                ),
+            ),
+        )
+        store = MemoryObservationStore()
+        res = run_trial(trial, store, OBJ)
+        assert res.condition is TrialCondition.SUCCEEDED
+        assert len(store.get("fd1", "accuracy")) == 1  # file only, stdout ignored
+
+    def test_stop_event_kills_running_whitebox_trials(self):
+        # goal reached on first trial; a slow sibling must be killed promptly
+        def trainer(ctx):
+            if ctx.params["x"] > 0:
+                ctx.report(accuracy=0.99)
+                return
+            for _ in range(200):
+                if ctx.should_stop():
+                    return
+                time.sleep(0.05)
+            ctx.report(accuracy=0.0)
+
+        spec = make_spec(
+            train_fn=trainer,
+            objective=ObjectiveSpec(
+                type=ObjectiveType.MAXIMIZE, objective_metric_name="accuracy", goal=0.9
+            ),
+            parameters=[
+                ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min=-1.0, max=1.0)),
+            ],
+            parallel_trial_count=4,
+            max_trial_count=20,
+        )
+        t0 = time.time()
+        exp = Orchestrator().run(spec)
+        assert exp.condition is ExperimentCondition.GOAL_REACHED
+        assert time.time() - t0 < 8.0  # nowhere near the 10s sleep loops
