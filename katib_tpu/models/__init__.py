@@ -1,0 +1,2 @@
+from katib_tpu.models.data import Dataset, load_cifar10, load_mnist  # noqa: F401
+from katib_tpu.models.mnist import MLP, SmallCNN, mnist_trial, train_classifier  # noqa: F401

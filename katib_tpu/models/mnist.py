@@ -1,0 +1,179 @@
+"""Tunable MNIST models + the standard white-box trial function.
+
+Parity target: the reference's ``pytorch-mnist`` trial image
+(``examples/v1beta1/trial-images/pytorch-mnist/mnist.py``) — an MLP/CNN with
+tunable lr/momentum that prints accuracy lines for the sidecar.  Here the
+trainer is a JAX function on a device mesh reporting metrics through the
+trial context; hyperparameters arrive typed.
+
+Tunable parameters understood by ``mnist_trial``: lr, momentum, units,
+num_layers, batch_size, epochs, optimizer(sgd|adam|momentum), arch(mlp|cnn).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from katib_tpu.models.data import Dataset, batches, load_mnist
+from katib_tpu.parallel.mesh import shard_batch
+from katib_tpu.parallel.train import (
+    TrainState,
+    accuracy,
+    cross_entropy_loss,
+    make_eval_step,
+    make_train_step,
+)
+
+
+class MLP(nn.Module):
+    units: int = 64
+    num_layers: int = 2
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for _ in range(self.num_layers):
+            x = nn.Dense(self.units, dtype=self.dtype)(x)
+            x = nn.relu(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+class SmallCNN(nn.Module):
+    channels: int = 32
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.channels, (3, 3), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(self.channels * 2, (3, 3), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.channels * 4, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+def make_optimizer(name: str, lr: float, momentum: float = 0.9):
+    if name == "adam":
+        return optax.adam(lr)
+    if name == "momentum":
+        return optax.sgd(lr, momentum=momentum)
+    return optax.sgd(lr)
+
+
+def train_classifier(
+    model: nn.Module,
+    dataset: Dataset,
+    *,
+    lr: float,
+    epochs: int,
+    batch_size: int,
+    optimizer: str = "momentum",
+    momentum: float = 0.9,
+    mesh=None,
+    seed: int = 0,
+    report=None,
+    eval_batch: int = 1024,
+) -> float:
+    """Train and return final test accuracy; calls ``report(epoch, acc, loss)``
+    per epoch when given (the trial metrics hook)."""
+    rng = np.random.default_rng(seed)
+    params = model.init(
+        jax.random.PRNGKey(seed), jnp.zeros((1, *dataset.input_shape), jnp.float32)
+    )
+    tx = make_optimizer(optimizer, lr, momentum)
+    state = TrainState.create(params, tx)
+
+    def loss_fn(params, batch):
+        x, y = batch
+        logits = model.apply(params, x)
+        return cross_entropy_loss(logits, y)
+
+    def metric_fn(params, batch):
+        x, y = batch
+        logits = model.apply(params, x)
+        return {
+            "accuracy": accuracy(logits, y),
+            "loss": cross_entropy_loss(logits, y),
+        }
+
+    step = make_train_step(loss_fn, tx, mesh)
+    evaluate = make_eval_step(metric_fn, mesh)
+    if mesh is not None:
+        from katib_tpu.parallel.mesh import replicate
+
+        state = replicate(state, mesh)
+
+    test_acc = 0.0
+    for epoch in range(epochs):
+        train_loss = 0.0
+        n = 0
+        for xb, yb in batches(dataset.x_train, dataset.y_train, batch_size, rng):
+            batch = (xb, yb) if mesh is None else shard_batch((xb, yb), mesh)
+            state, metrics = step(state, batch)
+            train_loss += float(metrics["loss"])
+            n += 1
+        # eval on a fixed prefix of the test split
+        xe = dataset.x_test[:eval_batch]
+        ye = dataset.y_test[:eval_batch]
+        ebatch = (xe, ye) if mesh is None else shard_batch((xe, ye), mesh)
+        em = evaluate(state.params, ebatch)
+        test_acc = float(em["accuracy"])
+        if report is not None:
+            cont = report(
+                epoch=epoch,
+                accuracy=test_acc,
+                loss=train_loss / max(n, 1),
+            )
+            if cont is False:
+                break
+    return test_acc
+
+
+# -- the white-box trial function (workload parity with pytorch-mnist) -------
+
+_DATASET_CACHE: dict[tuple, Dataset] = {}
+
+
+def _cached_mnist(n_train: int, n_test: int) -> Dataset:
+    key = (n_train, n_test)
+    if key not in _DATASET_CACHE:
+        _DATASET_CACHE[key] = load_mnist(n_train, n_test)
+    return _DATASET_CACHE[key]
+
+
+def mnist_trial(ctx) -> None:
+    """White-box trial: tunable MNIST classifier reporting accuracy/loss."""
+    p = ctx.params
+    arch = str(p.get("arch", "mlp"))
+    if arch == "cnn":
+        model = SmallCNN(channels=int(p.get("channels", 32)))
+    else:
+        model = MLP(units=int(p.get("units", 64)), num_layers=int(p.get("num_layers", 2)))
+    dataset = _cached_mnist(int(p.get("n_train", 4096)), int(p.get("n_test", 1024)))
+
+    def report(epoch, accuracy, loss):
+        return ctx.report(step=epoch, accuracy=accuracy, loss=loss)
+
+    train_classifier(
+        model,
+        dataset,
+        lr=float(p.get("lr", 0.05)),
+        momentum=float(p.get("momentum", 0.9)),
+        epochs=int(p.get("epochs", 3)),
+        batch_size=int(p.get("batch_size", 256)),
+        optimizer=str(p.get("optimizer", "momentum")),
+        mesh=ctx.mesh,
+        report=report,
+    )

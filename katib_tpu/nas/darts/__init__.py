@@ -1,0 +1,10 @@
+from katib_tpu.nas.darts.architect import DartsHyper, make_search_step  # noqa: F401
+from katib_tpu.nas.darts.model import (  # noqa: F401
+    Alphas,
+    DartsNetwork,
+    Genotype,
+    extract_genotype,
+    init_alphas,
+)
+from katib_tpu.nas.darts.search import darts_trial, run_darts_search  # noqa: F401
+from katib_tpu.nas.darts.service import DartsSuggester  # noqa: F401

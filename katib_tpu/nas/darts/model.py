@@ -1,0 +1,185 @@
+"""DARTS supernet: cells of mixed ops with architecture parameters.
+
+Parity with the reference trial image's supernet
+(``examples/v1beta1/trial-images/darts-cnn-cifar10/model.py``: ``Cell`` :21,
+``NetworkCNN`` :74, genotype extraction :187), restructured for JAX:
+
+- architecture parameters (alphas) are NOT flax parameters of the network —
+  they are an explicit pytree passed to ``apply``.  The bilevel optimization
+  differentiates w and alpha independently, so keeping them as separate
+  arguments gives ``jax.grad(..., argnums=...)`` directly instead of
+  surgically splitting a parameter dict;
+- cells are optionally wrapped in ``jax.checkpoint`` (remat) so the supernet
+  (every primitive evaluated on every edge) fits HBM at CIFAR scale — the
+  reference needs two full model copies for its virtual step, and so do we.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from katib_tpu.nas.darts.ops import (
+    DEFAULT_PRIMITIVES,
+    FactorizedReduce,
+    MixedOp,
+    ReluConvBn,
+    batch_norm,
+)
+
+
+class Alphas(NamedTuple):
+    """Architecture parameters: one row of op-logits per edge."""
+
+    normal: jnp.ndarray  # (n_edges, n_ops)
+    reduce: jnp.ndarray  # (n_edges, n_ops)
+
+
+def n_edges(n_nodes: int) -> int:
+    # node j has j+2 incoming edges (from 2 cell inputs + prior nodes)
+    return sum(j + 2 for j in range(n_nodes))
+
+
+def init_alphas(
+    n_nodes: int, n_ops: int, rng: jax.Array, scale: float = 1e-3
+) -> Alphas:
+    k = n_edges(n_nodes)
+    r1, r2 = jax.random.split(rng)
+    return Alphas(
+        normal=scale * jax.random.normal(r1, (k, n_ops), jnp.float32),
+        reduce=scale * jax.random.normal(r2, (k, n_ops), jnp.float32),
+    )
+
+
+class Cell(nn.Module):
+    """One DARTS cell (reference ``model.py:21``): nodes connected by mixed
+    ops; output = channel-concat of the intermediate nodes."""
+
+    primitives: Sequence[str]
+    channels: int
+    n_nodes: int = 4
+    reduction: bool = False
+    reduction_prev: bool = False
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, s0, s1, weights):
+        # weights: (n_edges, n_ops) softmaxed alphas for this cell type
+        if self.reduction_prev:
+            s0 = FactorizedReduce(self.channels, dtype=self.dtype)(s0)
+        else:
+            s0 = ReluConvBn(self.channels, dtype=self.dtype)(s0)
+        s1 = ReluConvBn(self.channels, dtype=self.dtype)(s1)
+
+        states = [s0, s1]
+        offset = 0
+        for node in range(self.n_nodes):
+            total = None
+            for i, h in enumerate(states):
+                stride = 2 if self.reduction and i < 2 else 1
+                out = MixedOp(
+                    self.primitives, self.channels, stride, dtype=self.dtype
+                )(h, weights[offset + i])
+                total = out if total is None else total + out
+            offset += len(states)
+            states.append(total)
+        return jnp.concatenate(states[2:], axis=-1)
+
+
+class DartsNetwork(nn.Module):
+    """Supernet (reference ``model.py:74`` NetworkCNN): stem + cells with
+    reductions at 1/3 and 2/3 depth, global pool, linear classifier."""
+
+    primitives: Sequence[str] = DEFAULT_PRIMITIVES
+    init_channels: int = 16
+    num_layers: int = 8
+    n_nodes: int = 4
+    num_classes: int = 10
+    stem_multiplier: int = 3
+    remat: bool = True
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, alphas: Alphas):
+        w_normal = jax.nn.softmax(alphas.normal.astype(jnp.float32), axis=-1)
+        w_reduce = jax.nn.softmax(alphas.reduce.astype(jnp.float32), axis=-1)
+
+        c_cur = self.init_channels * self.stem_multiplier
+        x = nn.Conv(
+            c_cur, (3, 3), padding="SAME", use_bias=False, dtype=self.dtype
+        )(x.astype(self.dtype))
+        s0 = s1 = batch_norm(x)
+
+        c = self.init_channels
+        reduction_prev = False
+        reduction_layers = {self.num_layers // 3, 2 * self.num_layers // 3}
+        cell_cls = nn.remat(Cell) if self.remat else Cell
+        for layer in range(self.num_layers):
+            reduction = layer in reduction_layers and self.num_layers > 2
+            if reduction:
+                c *= 2
+            cell = cell_cls(
+                primitives=self.primitives,
+                channels=c,
+                n_nodes=self.n_nodes,
+                reduction=reduction,
+                reduction_prev=reduction_prev,
+                dtype=self.dtype,
+            )
+            weights = w_reduce if reduction else w_normal
+            s0, s1 = s1, cell(s0, s1, weights)
+            reduction_prev = reduction
+
+        out = jnp.mean(s1, axis=(1, 2))  # global average pool
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(out.astype(jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# Genotype extraction (reference ``model.py:187``)
+# ---------------------------------------------------------------------------
+
+
+class Genotype(NamedTuple):
+    normal: list
+    reduce: list
+
+    def render(self) -> str:
+        return f"Genotype(normal={self.normal}, reduce={self.reduce})"
+
+
+def extract_genotype(
+    alphas: Alphas, primitives: Sequence[str], n_nodes: int = 4
+) -> Genotype:
+    """Discretize: per node keep the top-2 incoming edges ranked by their
+    strongest non-'none' op weight; each kept edge uses that op."""
+    import numpy as np
+
+    def parse(matrix) -> list:
+        weights = np.asarray(jax.nn.softmax(jnp.asarray(matrix, jnp.float32), axis=-1))
+        try:
+            none_idx = list(primitives).index("none")
+        except ValueError:
+            none_idx = None
+        gene = []
+        offset = 0
+        for node in range(n_nodes):
+            k = node + 2
+            edges = weights[offset : offset + k]
+            scores = []
+            for e in range(k):
+                row = edges[e].copy()
+                if none_idx is not None:
+                    row[none_idx] = -np.inf
+                best_op = int(np.argmax(row))
+                scores.append((float(row[best_op]), e, best_op))
+            scores.sort(reverse=True)
+            gene.append(
+                [(primitives[op], edge) for _, edge, op in sorted(scores[:2], key=lambda t: t[1])]
+            )
+            offset += k
+        return gene
+
+    return Genotype(normal=parse(alphas.normal), reduce=parse(alphas.reduce))

@@ -1,0 +1,214 @@
+"""DARTS primitive operations as flax modules.
+
+Parity with the reference trial image's op set
+(``examples/v1beta1/trial-images/darts-cnn-cifar10/operations.py:18-31``):
+none / avg_pooling_3x3 / max_pooling_3x3 / skip_connection /
+separable_convolution_{3x3,5x5} / dilated_convolution_{3x3,5x5}.
+
+TPU-first choices:
+- NHWC layout (the TPU-native conv layout; the reference is NCHW CUDA);
+- bfloat16 compute, float32 normalization statistics;
+- stateless batch normalization: DARTS search always runs BN in training mode
+  with ``affine=False`` (running stats are never consumed during search), so
+  normalizing with the current batch's statistics is functionally equivalent
+  and keeps the whole supernet a pure function — no mutable collections to
+  thread through the bilevel derivatives;
+- the mixed op computes every primitive and contracts with softmax weights in
+  one einsum — a static-shape program XLA can schedule densely on the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+DEFAULT_PRIMITIVES = (
+    "none",
+    "max_pooling_3x3",
+    "avg_pooling_3x3",
+    "skip_connection",
+    "separable_convolution_3x3",
+    "separable_convolution_5x5",
+    "dilated_convolution_3x3",
+    "dilated_convolution_5x5",
+)
+
+
+def batch_norm(x: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    """Training-mode BN over (N, H, W), no affine, stateless (see module doc)."""
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=(0, 1, 2), keepdims=True)
+    var = jnp.var(x32, axis=(0, 1, 2), keepdims=True)
+    return ((x32 - mean) * jnp.sqrt(1.0 / (var + eps))).astype(x.dtype)
+
+
+class ReluConvBn(nn.Module):
+    channels: int
+    kernel: int = 1
+    stride: int = 1
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(x)
+        x = nn.Conv(
+            self.channels,
+            (self.kernel, self.kernel),
+            strides=(self.stride, self.stride),
+            padding="SAME",
+            use_bias=False,
+            dtype=self.dtype,
+        )(x)
+        return batch_norm(x)
+
+
+class SepConv(nn.Module):
+    """Depthwise-separable conv applied twice (reference SepConv stacks two)."""
+
+    channels: int
+    kernel: int
+    stride: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        for i, stride in enumerate((self.stride, 1)):
+            x = nn.relu(x)
+            x = nn.Conv(
+                x.shape[-1],
+                (self.kernel, self.kernel),
+                strides=(stride, stride),
+                padding="SAME",
+                feature_group_count=x.shape[-1],
+                use_bias=False,
+                dtype=self.dtype,
+            )(x)
+            x = nn.Conv(
+                self.channels, (1, 1), use_bias=False, dtype=self.dtype
+            )(x)
+            x = batch_norm(x)
+        return x
+
+
+class DilConv(nn.Module):
+    """Dilated depthwise-separable conv (3x3 d2 -> rf 5x5; 5x5 d2 -> rf 9x9)."""
+
+    channels: int
+    kernel: int
+    stride: int
+    dilation: int = 2
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(x)
+        x = nn.Conv(
+            x.shape[-1],
+            (self.kernel, self.kernel),
+            strides=(self.stride, self.stride),
+            padding="SAME",
+            kernel_dilation=(self.dilation, self.dilation),
+            feature_group_count=x.shape[-1],
+            use_bias=False,
+            dtype=self.dtype,
+        )(x)
+        x = nn.Conv(self.channels, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        return batch_norm(x)
+
+
+class FactorizedReduce(nn.Module):
+    """Stride-2 spatial reduction preserving information via two offset 1x1
+    convs (reference ``operations.py`` FactorizedReduce)."""
+
+    channels: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(x)
+        a = nn.Conv(
+            self.channels // 2, (1, 1), strides=(2, 2), use_bias=False, dtype=self.dtype
+        )(x)
+        b = nn.Conv(
+            self.channels // 2, (1, 1), strides=(2, 2), use_bias=False, dtype=self.dtype
+        )(x[:, 1:, 1:, :])
+        # pad b back to a's spatial shape (off-by-one from the shifted slice)
+        pad_h = a.shape[1] - b.shape[1]
+        pad_w = a.shape[2] - b.shape[2]
+        b = jnp.pad(b, ((0, 0), (0, pad_h), (0, pad_w), (0, 0)))
+        return batch_norm(jnp.concatenate([a, b], axis=-1))
+
+
+class Pool(nn.Module):
+    kind: str  # "avg" | "max"
+    stride: int
+
+    @nn.compact
+    def __call__(self, x):
+        window = (3, 3)
+        strides = (self.stride, self.stride)
+        if self.kind == "avg":
+            out = nn.avg_pool(x, window, strides=strides, padding="SAME")
+        else:
+            out = nn.max_pool(x, window, strides=strides, padding="SAME")
+        return batch_norm(out)
+
+
+class Zero(nn.Module):
+    stride: int
+
+    @nn.compact
+    def __call__(self, x):
+        if self.stride == 1:
+            return jnp.zeros_like(x)
+        return jnp.zeros_like(x[:, :: self.stride, :: self.stride, :])
+
+
+class SkipConnect(nn.Module):
+    channels: int
+    stride: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        if self.stride == 1:
+            return x
+        return FactorizedReduce(self.channels, dtype=self.dtype)(x)
+
+
+def build_op(name: str, channels: int, stride: int, dtype=jnp.bfloat16) -> nn.Module:
+    """Primitive factory (reference ``OPS`` table, ``operations.py:18``)."""
+    table: dict[str, Callable[[], nn.Module]] = {
+        "none": lambda: Zero(stride),
+        "avg_pooling_3x3": lambda: Pool("avg", stride),
+        "max_pooling_3x3": lambda: Pool("max", stride),
+        "skip_connection": lambda: SkipConnect(channels, stride, dtype=dtype),
+        "separable_convolution_3x3": lambda: SepConv(channels, 3, stride, dtype=dtype),
+        "separable_convolution_5x5": lambda: SepConv(channels, 5, stride, dtype=dtype),
+        "dilated_convolution_3x3": lambda: DilConv(channels, 3, stride, dtype=dtype),
+        "dilated_convolution_5x5": lambda: DilConv(channels, 5, stride, dtype=dtype),
+    }
+    if name not in table:
+        raise ValueError(f"unknown primitive {name!r}; known: {sorted(table)}")
+    return table[name]()
+
+
+class MixedOp(nn.Module):
+    """Continuous relaxation of one edge: softmax-weighted sum of primitives."""
+
+    primitives: Sequence[str]
+    channels: int
+    stride: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, weights):
+        # weights: (n_ops,) softmax over this edge's alphas
+        outs = [
+            build_op(p, self.channels, self.stride, self.dtype)(x)
+            for p in self.primitives
+        ]
+        stacked = jnp.stack(outs, axis=0)  # (n_ops, N, H, W, C)
+        return jnp.einsum("o,onhwc->nhwc", weights.astype(stacked.dtype), stacked)

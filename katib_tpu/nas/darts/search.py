@@ -1,0 +1,198 @@
+"""DARTS search driver: the white-box trial workload.
+
+Parity with the reference trial image's epoch loop
+(``examples/v1beta1/trial-images/darts-cnn-cifar10/run_trial.py:148-233``):
+split train data 50/50 into w-set and alpha-set, run bilevel steps per batch,
+validate each epoch, print the best genotype at the end.  Here the "print
+Best-Genotype= line for the sidecar regex" becomes: report accuracy through
+the trial context and write ``genotype.json`` to the trial checkpoint dir.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from katib_tpu.models.data import Dataset, batches, load_cifar10
+from katib_tpu.nas.darts.architect import (
+    DartsHyper,
+    SearchState,
+    init_search_state,
+    make_search_step,
+)
+from katib_tpu.nas.darts.model import (
+    Alphas,
+    DartsNetwork,
+    extract_genotype,
+    init_alphas,
+)
+from katib_tpu.nas.darts.ops import DEFAULT_PRIMITIVES
+from katib_tpu.parallel.mesh import replicate, shard_batch
+from katib_tpu.parallel.train import accuracy, cross_entropy_loss, make_eval_step
+
+
+def run_darts_search(
+    dataset: Dataset,
+    *,
+    primitives=DEFAULT_PRIMITIVES,
+    num_layers: int = 8,
+    init_channels: int = 16,
+    n_nodes: int = 4,
+    stem_multiplier: int = 3,
+    num_epochs: int = 10,
+    batch_size: int = 128,
+    hyper: DartsHyper | None = None,
+    mesh=None,
+    seed: int = 0,
+    report=None,
+) -> dict[str, Any]:
+    """Run the bilevel architecture search; returns genotype + final metrics."""
+    net = DartsNetwork(
+        primitives=tuple(primitives),
+        init_channels=init_channels,
+        num_layers=num_layers,
+        n_nodes=n_nodes,
+        num_classes=dataset.num_classes,
+        stem_multiplier=stem_multiplier,
+    )
+    rng = np.random.default_rng(seed)
+    key = jax.random.PRNGKey(seed)
+    k_init, k_alpha = jax.random.split(key)
+
+    # 50/50 split: w trains on one half, alpha on the other (run_trial.py:98-111)
+    n = len(dataset.x_train)
+    perm = rng.permutation(n)
+    half = n // 2
+    w_idx, a_idx = perm[:half], perm[half:]
+    x_w, y_w = dataset.x_train[w_idx], dataset.y_train[w_idx]
+    x_a, y_a = dataset.x_train[a_idx], dataset.y_train[a_idx]
+
+    sample = jnp.zeros((1, *dataset.input_shape), jnp.float32)
+    alphas = init_alphas(n_nodes, len(primitives), k_alpha)
+    weights = net.init(k_init, sample, alphas)
+
+    steps_per_epoch = max(1, half // batch_size)
+    if hyper is None:
+        hyper = DartsHyper()
+    hyper = hyper._replace(total_steps=max(1, steps_per_epoch * num_epochs))
+
+    def loss_fn(w, a, batch):
+        x, y = batch
+        return cross_entropy_loss(net.apply(w, x, a), y)
+
+    def metric_fn(carry, batch):
+        w, a = carry
+        x, y = batch
+        logits = net.apply(w, x, a)
+        return {"accuracy": accuracy(logits, y), "loss": cross_entropy_loss(logits, y)}
+
+    search_step = make_search_step(loss_fn, hyper, mesh)
+    evaluate = jax.jit(metric_fn) if mesh is None else make_eval_step(metric_fn, mesh)
+
+    state = init_search_state(weights, alphas, hyper)
+    if mesh is not None:
+        state = replicate(state, mesh)
+
+    best_acc = 0.0
+    history = []
+    for epoch in range(num_epochs):
+        w_stream = batches(x_w, y_w, batch_size, rng)
+        a_stream = batches(x_a, y_a, batch_size, rng)
+        train_loss = 0.0
+        steps = 0
+        for wb, ab in zip(w_stream, a_stream):
+            if mesh is not None:
+                wb, ab = shard_batch(wb, mesh), shard_batch(ab, mesh)
+            state, metrics = search_step(state, wb, ab)
+            train_loss += float(metrics["train_loss"])
+            steps += 1
+
+        ne = min(len(dataset.x_test), 1024)
+        eval_batch = (dataset.x_test[:ne], dataset.y_test[:ne])
+        if mesh is not None:
+            eval_batch = shard_batch(eval_batch, mesh)
+        em = evaluate((state.weights, state.alphas), eval_batch)
+        val_acc = float(em["accuracy"])
+        best_acc = max(best_acc, val_acc)
+        history.append(
+            {"epoch": epoch, "val_accuracy": val_acc, "train_loss": train_loss / max(steps, 1)}
+        )
+        if report is not None:
+            cont = report(epoch=epoch, accuracy=val_acc, loss=train_loss / max(steps, 1))
+            if cont is False:
+                break
+
+    genotype = extract_genotype(
+        jax.device_get(state.alphas), primitives, n_nodes=n_nodes
+    )
+    return {
+        "genotype": genotype,
+        "best_accuracy": best_acc,
+        "history": history,
+        "alphas": jax.device_get(state.alphas),
+    }
+
+
+def darts_trial(ctx) -> None:
+    """White-box DARTS trial (reference workload ``run_trial.py`` main).
+
+    Consumes the three parameters the DARTS suggester emits
+    (``darts/service.py:49-99``): ``algorithm-settings`` (JSON dict),
+    ``search-space`` (JSON list of primitives), ``num-layers``.
+    """
+    settings = json.loads(ctx.params.get("algorithm-settings", "{}"))
+    primitives = tuple(json.loads(ctx.params.get("search-space", "null")) or DEFAULT_PRIMITIVES)
+    num_layers = int(ctx.params.get("num-layers", 8))
+
+    def parse_bool(raw, default=True):
+        if isinstance(raw, bool):
+            return raw
+        return str(raw).strip().lower() not in ("false", "0", "no", "none", "")
+
+    n_train = int(settings.get("n_train", 8192))
+    dataset = load_cifar10(n_train, int(settings.get("n_test", 2048)))
+    hyper = DartsHyper(
+        w_lr=float(settings.get("w_lr", 0.025)),
+        w_lr_min=float(settings.get("w_lr_min", 0.001)),
+        w_momentum=float(settings.get("w_momentum", 0.9)),
+        w_weight_decay=float(settings.get("w_weight_decay", 3e-4)),
+        w_grad_clip=float(settings.get("w_grad_clip", 5.0)),
+        alpha_lr=float(settings.get("alpha_lr", 3e-4)),
+        alpha_weight_decay=float(settings.get("alpha_weight_decay", 1e-3)),
+        unrolled=parse_bool(settings.get("unrolled", True)),
+    )
+
+    def report(epoch, accuracy, loss):
+        return ctx.report(step=epoch, accuracy=accuracy, loss=loss)
+
+    result = run_darts_search(
+        dataset,
+        primitives=primitives,
+        num_layers=num_layers,
+        init_channels=int(settings.get("init_channels", 16)),
+        n_nodes=int(settings.get("num_nodes", 4)),
+        stem_multiplier=int(settings.get("stem_multiplier", 3)),
+        num_epochs=int(settings.get("num_epochs", 10)),
+        batch_size=int(settings.get("batch_size", 128)),
+        hyper=hyper,
+        mesh=ctx.mesh,
+        report=report,
+    )
+    # the reference prints Best-Genotype= for the stdout scraper; we persist
+    # the discrete architecture alongside the trial instead
+    out_dir = ctx.ensure_checkpoint_dir()
+    with open(os.path.join(out_dir, "genotype.json"), "w") as f:
+        json.dump(
+            {
+                "normal": result["genotype"].normal,
+                "reduce": result["genotype"].reduce,
+                "best_accuracy": result["best_accuracy"],
+            },
+            f,
+            indent=2,
+        )

@@ -1,0 +1,120 @@
+"""ENAS child network: builds a CNN from a sampled architecture.
+
+Parity with the reference's Keras model constructor
+(``examples/v1beta1/trial-images/enas-cnn-cifar10/ModelConstructor.py`` +
+``op_library.py``): one operation per layer (conv 3x3/5x5, separable conv,
+avg/max pool) plus skip connections that concatenate earlier layer outputs.
+The reference trains it with ``tf.distribute.MirroredStrategy`` over local
+GPUs (``RunTrial.py:54-62``); here the training loop is the shared
+mesh-sharded classifier trainer.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from katib_tpu.nas.enas.controller import Arc
+
+# operation vocabulary (op_library.py); index = controller's op id
+DEFAULT_OPERATIONS = (
+    "convolution_3x3",
+    "convolution_5x5",
+    "separable_convolution_3x3",
+    "separable_convolution_5x5",
+    "avg_pooling_3x3",
+    "max_pooling_3x3",
+)
+
+
+class _Op(nn.Module):
+    name_: str
+    channels: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        n = self.name_
+        if n.startswith("convolution"):
+            k = int(n.split("_")[-1][0])
+            x = nn.Conv(self.channels, (k, k), padding="SAME", dtype=self.dtype)(x)
+            x = nn.relu(x)
+        elif n.startswith("separable_convolution"):
+            k = int(n.split("_")[-1][0])
+            x = nn.Conv(
+                x.shape[-1],
+                (k, k),
+                padding="SAME",
+                feature_group_count=x.shape[-1],
+                use_bias=False,
+                dtype=self.dtype,
+            )(x)
+            x = nn.Conv(self.channels, (1, 1), dtype=self.dtype)(x)
+            x = nn.relu(x)
+        elif n.startswith("avg_pooling"):
+            x = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+            x = nn.Conv(self.channels, (1, 1), dtype=self.dtype)(x)
+        elif n.startswith("max_pooling"):
+            x = nn.max_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+            x = nn.Conv(self.channels, (1, 1), dtype=self.dtype)(x)
+        else:
+            raise ValueError(f"unknown ENAS operation {n!r}")
+        return x
+
+
+class EnasChild(nn.Module):
+    """CNN instantiated from a controller arc (static: the arc is hashable
+    config, so each sampled architecture compiles once)."""
+
+    arc_ops: tuple  # per-layer op indices
+    arc_skips: tuple  # per-layer tuple of 0/1 for earlier layers
+    operations: Sequence[str] = DEFAULT_OPERATIONS
+    channels: int = 32
+    num_classes: int = 10
+    pool_every: int = 3
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.channels, (3, 3), padding="SAME", dtype=self.dtype)(x)
+        outputs = []
+        for layer, op_idx in enumerate(self.arc_ops):
+            inp = x
+            skips = self.arc_skips[layer]
+            used = [outputs[j] for j, s in enumerate(skips) if s]
+            if used:
+                inp = jnp.concatenate([inp, *used], axis=-1)
+            x = _Op(self.operations[op_idx], self.channels, dtype=self.dtype)(inp)
+            outputs.append(x)
+            if (layer + 1) % self.pool_every == 0:
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+                # downsample stored outputs so later skip concats still align
+                outputs = [
+                    nn.max_pool(o, (2, 2), strides=(2, 2)) for o in outputs
+                ]
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x.astype(jnp.float32))
+
+
+def child_from_arc(
+    arc: Arc,
+    operations: Sequence[str] = DEFAULT_OPERATIONS,
+    channels: int = 32,
+    num_classes: int = 10,
+) -> EnasChild:
+    ops = tuple(int(o) for o in np.asarray(arc.ops))
+    skips = tuple(
+        tuple(int(s) for s in np.asarray(arc.skips)[layer, :layer])
+        for layer in range(len(ops))
+    )
+    return EnasChild(
+        arc_ops=ops,
+        arc_skips=skips,
+        operations=tuple(operations),
+        channels=channels,
+        num_classes=num_classes,
+    )

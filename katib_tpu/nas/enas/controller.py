@@ -1,0 +1,254 @@
+"""ENAS controller: LSTM architecture sampler + REINFORCE trainer in JAX.
+
+Parity with the reference's TF1-graph controller
+(``pkg/suggestion/v1beta1/nas/enas/Controller.py``): a single-cell LSTM
+(hidden 64) samples one operation per layer and, from layer 1 on, an
+attention-scored binary skip decision to every earlier layer
+(``_build_sampler`` :81-198); REINFORCE with entropy bonus, EMA baseline and
+a KL skip-rate penalty trains it on child validation accuracy
+(``build_trainer`` :198-257).
+
+JAX redesign: the controller is a pure function of (params, rng) —
+sampling returns the arc plus its log-prob/entropy/skip stats, the REINFORCE
+update is ``jax.grad`` of log_prob * advantage re-evaluated on the stored
+arc, and the whole train step is jitted.  No TF session, no
+``ctrl_cache/`` checkpoint files — params are a pytree the service persists
+with everything else.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+class ControllerParams(NamedTuple):
+    w_lstm: jnp.ndarray  # (2H, 4H)
+    g_emb: jnp.ndarray  # (1, H)
+    w_emb: jnp.ndarray  # (num_ops, H)
+    w_soft: jnp.ndarray  # (H, num_ops)
+    attn_w1: jnp.ndarray  # (H, H)
+    attn_w2: jnp.ndarray  # (H, H)
+    attn_v: jnp.ndarray  # (H, 1)
+
+
+class ControllerConfig(NamedTuple):
+    """Defaults mirror ``AlgorithmSettings.py`` (hidden 64, temp 5.0, ...)."""
+
+    num_layers: int = 8
+    num_operations: int = 6
+    hidden_size: int = 64
+    temperature: float | None = 5.0
+    tanh_const: float | None = 2.25
+    entropy_weight: float | None = 1e-5
+    baseline_decay: float = 0.999
+    learning_rate: float = 5e-5
+    skip_target: float = 0.4
+    skip_weight: float | None = 0.8
+
+
+class Arc(NamedTuple):
+    ops: jnp.ndarray  # (num_layers,) int32
+    skips: jnp.ndarray  # (num_layers, num_layers) lower-triangular 0/1
+
+
+def init_controller(cfg: ControllerConfig, key: jax.Array) -> ControllerParams:
+    h = cfg.hidden_size
+    ks = jax.random.split(key, 7)
+    u = lambda k, shape: jax.random.uniform(k, shape, jnp.float32, -0.01, 0.01)
+    return ControllerParams(
+        w_lstm=u(ks[0], (2 * h, 4 * h)),
+        g_emb=u(ks[1], (1, h)),
+        w_emb=u(ks[2], (cfg.num_operations, h)),
+        w_soft=u(ks[3], (h, cfg.num_operations)),
+        attn_w1=u(ks[4], (h, h)),
+        attn_w2=u(ks[5], (h, h)),
+        attn_v=u(ks[6], (h, 1)),
+    )
+
+
+def _lstm(x, c, h, w):
+    ifog = jnp.concatenate([x, h], axis=1) @ w
+    i, f, o, g = jnp.split(ifog, 4, axis=1)
+    c2 = jax.nn.sigmoid(i) * jnp.tanh(g) + jax.nn.sigmoid(f) * c
+    return c2, jax.nn.sigmoid(o) * jnp.tanh(c2)
+
+
+def _shape_logits(logits, cfg: ControllerConfig):
+    if cfg.temperature is not None:
+        logits = logits / cfg.temperature
+    if cfg.tanh_const is not None:
+        logits = cfg.tanh_const * jnp.tanh(logits)
+    return logits
+
+
+def _trace(params: ControllerParams, cfg: ControllerConfig, arc: Arc, key=None):
+    """Run the controller over a (given or sampled) arc, accumulating
+    log-probs, entropies and skip penalties.
+
+    When ``key`` is provided the arc argument is ignored per-step and actions
+    are sampled; either way the returned quantities are differentiable wrt
+    params for the supplied/sampled actions (the REINFORCE trick: re-evaluate
+    log p(arc) on the stored arc).
+    """
+    h_size = cfg.hidden_size
+    c = jnp.zeros((1, h_size))
+    h = jnp.zeros((1, h_size))
+    inputs = params.g_emb
+    skip_targets = jnp.array([1.0 - cfg.skip_target, cfg.skip_target])
+
+    ops: list = []
+    skips: list = []
+    log_prob = 0.0
+    entropy = 0.0
+    skip_penalty = 0.0
+    skip_count = 0.0
+    all_h: list = []
+    all_hw: list = []
+    keys = (
+        jax.random.split(key, 2 * cfg.num_layers) if key is not None else [None] * (2 * cfg.num_layers)
+    )
+
+    for layer in range(cfg.num_layers):
+        c, h = _lstm(inputs, c, h, params.w_lstm)
+        logits = _shape_logits(h @ params.w_soft, cfg)  # (1, num_ops)
+        if key is not None:
+            op = jax.random.categorical(keys[2 * layer], logits[0])
+        else:
+            op = arc.ops[layer]
+        logp = jax.nn.log_softmax(logits[0])[op]
+        log_prob = log_prob + logp
+        entropy = entropy + jax.lax.stop_gradient(-logp * jnp.exp(logp))
+        ops.append(op)
+        inputs = params.w_emb[op][None, :]
+
+        c, h = _lstm(inputs, c, h, params.w_lstm)
+        if layer > 0:
+            prev_h = jnp.concatenate(all_h, axis=0)  # (layer, H)
+            prev_hw = jnp.concatenate(all_hw, axis=0)  # (layer, H)
+            query = jnp.tanh(h @ params.attn_w2 + prev_hw) @ params.attn_v  # (layer, 1)
+            sk_logits = _shape_logits(
+                jnp.concatenate([-query, query], axis=1), cfg
+            )  # (layer, 2)
+            if key is not None:
+                sk = jax.random.categorical(keys[2 * layer + 1], sk_logits, axis=-1)
+            else:
+                sk = arc.skips[layer, :layer]
+            sk = sk.astype(jnp.int32)
+            logp_all = jax.nn.log_softmax(sk_logits, axis=-1)
+            logp_sk = jnp.take_along_axis(logp_all, sk[:, None], axis=1).sum()
+            log_prob = log_prob + logp_sk
+            entropy = entropy + jax.lax.stop_gradient(-logp_sk * jnp.exp(-(-logp_sk)))
+            # KL(skip distribution || target rate) penalty (Controller.py:156-159)
+            skip_prob = jax.nn.sigmoid(sk_logits)
+            kl = (skip_prob * jnp.log(skip_prob / skip_targets)).sum()
+            skip_penalty = skip_penalty + kl
+            skf = sk.astype(jnp.float32)
+            skip_count = skip_count + skf.sum()
+            inputs = (skf[None, :] @ prev_h) / (1.0 + skf.sum())
+            row = jnp.zeros((cfg.num_layers,), jnp.int32).at[:layer].set(sk)
+        else:
+            inputs = params.g_emb
+            row = jnp.zeros((cfg.num_layers,), jnp.int32)
+        skips.append(row)
+        all_h.append(h)
+        all_hw.append(h @ params.attn_w1)
+
+    out_arc = Arc(ops=jnp.stack(ops).astype(jnp.int32), skips=jnp.stack(skips))
+    stats = {
+        "log_prob": log_prob,
+        "entropy": entropy,
+        "skip_penalty": skip_penalty / max(cfg.num_layers - 1, 1),
+        "skip_count": skip_count,
+    }
+    return out_arc, stats
+
+
+def sample_arc(params: ControllerParams, cfg: ControllerConfig, key: jax.Array):
+    dummy = Arc(
+        ops=jnp.zeros((cfg.num_layers,), jnp.int32),
+        skips=jnp.zeros((cfg.num_layers, cfg.num_layers), jnp.int32),
+    )
+    return _trace(params, cfg, dummy, key=key)
+
+
+class ReinforceState(NamedTuple):
+    params: ControllerParams
+    opt_state: optax.OptState
+    baseline: jnp.ndarray
+    step: jnp.ndarray
+
+
+def make_reinforce(cfg: ControllerConfig):
+    """Build (init, train_step, sample) for controller REINFORCE training."""
+    tx = optax.adam(cfg.learning_rate)
+
+    def init(key: jax.Array) -> ReinforceState:
+        params = init_controller(cfg, key)
+        return ReinforceState(
+            params=params,
+            opt_state=tx.init(params),
+            baseline=jnp.zeros(()),
+            step=jnp.zeros((), jnp.int32),
+        )
+
+    @jax.jit
+    def train_step(state: ReinforceState, arc: Arc, reward: jnp.ndarray):
+        """One REINFORCE step on a sampled arc with observed reward
+        (``build_trainer``: reward += entropy bonus; EMA baseline; loss =
+        log_prob * (reward - baseline) + skip_weight * skip_penalty)."""
+
+        def loss_fn(params):
+            _, stats = _trace(params, cfg, arc)
+            r = reward
+            if cfg.entropy_weight is not None:
+                r = r + cfg.entropy_weight * stats["entropy"]
+            baseline = state.baseline - (1.0 - cfg.baseline_decay) * (
+                state.baseline - r
+            )
+            # REINFORCE under gradient DESCENT: loss = -log p * advantage.
+            # (The reference's ``sample_log_probs`` are TF cross-entropies,
+            # i.e. already -log p, so its ``loss = log_probs * advantage``
+            # carries the same sign, Controller.py:133,219.)
+            loss = -stats["log_prob"] * jax.lax.stop_gradient(r - baseline)
+            if cfg.skip_weight is not None:
+                loss = loss + cfg.skip_weight * stats["skip_penalty"]
+            return loss, baseline
+
+        (loss, baseline), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params
+        )
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return (
+            ReinforceState(params, opt_state, baseline, state.step + 1),
+            {"loss": loss, "baseline": baseline},
+        )
+
+    sample = jax.jit(lambda params, key: sample_arc(params, cfg, key))
+    return init, train_step, sample
+
+
+def arc_to_json(arc: Arc) -> list:
+    """Serialize for the trial parameter (reference passes the architecture
+    as nested lists in the ``architecture`` parameter)."""
+    ops = np.asarray(arc.ops).tolist()
+    skips = np.asarray(arc.skips)
+    out = []
+    for layer, op in enumerate(ops):
+        out.append([int(op)] + [int(s) for s in skips[layer, :layer]])
+    return out
+
+
+def arc_from_json(data: list, num_layers: int) -> Arc:
+    ops = np.zeros((num_layers,), np.int32)
+    skips = np.zeros((num_layers, num_layers), np.int32)
+    for layer, row in enumerate(data):
+        ops[layer] = row[0]
+        for j, s in enumerate(row[1:]):
+            skips[layer, j] = s
+    return Arc(ops=jnp.asarray(ops), skips=jnp.asarray(skips))

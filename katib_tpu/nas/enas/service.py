@@ -1,0 +1,207 @@
+"""ENAS suggester — stateful RL controller service.
+
+Parity with the reference (``pkg/suggestion/v1beta1/nas/enas/service.py``):
+round 1 emits randomly-initialized-controller samples; every later round
+computes the mean validation accuracy of the completed trials
+(``GetEvaluationResult`` :400, sign-flipped for minimize), trains the
+controller ``controller_train_steps`` REINFORCE steps — each step samples a
+fresh arc and applies the round reward (:311-330) — then samples the next
+round's architectures.  Each trial carries two string parameters,
+``architecture`` (nested list: per layer [op_id, skip...]) and ``nn_config``
+(network shape + op vocabulary), exactly like the reference's trial inputs.
+
+The reference's TF Saver ``ctrl_cache/`` checkpoint (:278) is unnecessary:
+controller state is a JAX pytree living in the suggester; `state_dict()` /
+`load_state_dict()` expose it for orchestrator-level persistence.
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import numpy as np
+
+from katib_tpu.core.types import (
+    Experiment,
+    ExperimentSpec,
+    ParameterAssignment,
+    TrialAssignmentSet,
+)
+from katib_tpu.nas.enas.child import DEFAULT_OPERATIONS
+from katib_tpu.nas.enas.controller import (
+    ControllerConfig,
+    arc_to_json,
+    make_reinforce,
+)
+from katib_tpu.suggest.base import (
+    Suggester,
+    SuggesterError,
+    SuggestionsNotReady,
+    register,
+)
+
+ROUND_LABEL = "enas-round"
+
+_SETTING_TYPES = {
+    "controller_hidden_size": int,
+    "controller_temperature": float,
+    "controller_tanh_const": float,
+    "controller_entropy_weight": float,
+    "controller_baseline_decay": float,
+    "controller_learning_rate": float,
+    "controller_skip_target": float,
+    "controller_skip_weight": float,
+    "controller_train_steps": int,
+}
+
+
+def _operations_from_nas_config(nas_config) -> list[str]:
+    ops: list[str] = []
+    for op in nas_config.operations:
+        sizes = []
+        for p in op.parameters:
+            if p.name == "filter_size" and p.feasible.list:
+                sizes = list(p.feasible.list)
+        if sizes:
+            ops.extend(f"{op.operation_type}_{k}x{k}" for k in sizes)
+        else:
+            ops.append(op.operation_type)
+    return ops
+
+
+@register("enas")
+class EnasSuggester(Suggester):
+    @classmethod
+    def validate(cls, spec: ExperimentSpec) -> None:
+        if spec.nas_config is None or not spec.nas_config.operations:
+            raise SuggesterError("enas requires nas_config with operations")
+        s = spec.algorithm.settings
+        for name, caster in _SETTING_TYPES.items():
+            if name in s and s[name] != "None":
+                try:
+                    caster(s[name])
+                except (TypeError, ValueError):
+                    raise SuggesterError(f"{name} must be {caster.__name__}") from None
+        if "controller_baseline_decay" in s and not (
+            0.0 <= float(s["controller_baseline_decay"]) <= 1.0
+        ):
+            raise SuggesterError("controller_baseline_decay must be in [0, 1]")
+
+    def __init__(self, spec: ExperimentSpec):
+        super().__init__(spec)
+        s = dict(spec.algorithm.settings)
+
+        def get(name, default, caster):
+            raw = s.get(name)
+            if raw is None:
+                return default
+            if raw == "None":
+                return None
+            return caster(raw)
+
+        self.operations = (
+            _operations_from_nas_config(spec.nas_config)
+            if spec.nas_config
+            else list(DEFAULT_OPERATIONS)
+        )
+        self.num_layers = spec.nas_config.graph_config.num_layers if spec.nas_config else 8
+        self.cfg = ControllerConfig(
+            num_layers=self.num_layers,
+            num_operations=len(self.operations),
+            hidden_size=get("controller_hidden_size", 64, int),
+            temperature=get("controller_temperature", 5.0, float),
+            tanh_const=get("controller_tanh_const", 2.25, float),
+            entropy_weight=get("controller_entropy_weight", 1e-5, float),
+            baseline_decay=get("controller_baseline_decay", 0.999, float),
+            learning_rate=get("controller_learning_rate", 5e-5, float),
+            skip_target=get("controller_skip_target", 0.4, float),
+            skip_weight=get("controller_skip_weight", 0.8, float),
+        )
+        self.train_steps = get("controller_train_steps", 50, int)
+        init, self._train_step, self._sample = make_reinforce(self.cfg)
+        self._key = jax.random.PRNGKey(self.seed())
+        self.state = init(self._next_key())
+        self.round = 0
+        self._trained_rounds: set[int] = set()
+
+    def _next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    # -- persistence hooks --------------------------------------------------
+
+    def state_dict(self) -> dict:
+        return {
+            "round": self.round,
+            "trained_rounds": sorted(self._trained_rounds),
+            "controller": jax.device_get(self.state),
+        }
+
+    def load_state_dict(self, data: dict) -> None:
+        self.round = data["round"]
+        self._trained_rounds = set(data["trained_rounds"])
+        self.state = jax.tree_util.tree_map(lambda x: x, data["controller"])
+
+    # -- main ---------------------------------------------------------------
+
+    def _round_trials(self, experiment: Experiment, rnd: int):
+        return [
+            t
+            for t in experiment.trials.values()
+            if t.labels.get(ROUND_LABEL) == str(rnd)
+        ]
+
+    def _mean_reward(self, trials) -> float | None:
+        """Reference ``GetEvaluationResult``: mean objective of the round's
+        completed trials, sign-flipped for minimize."""
+        obj = self.spec.objective
+        sign = 1.0 if obj.type.value == "maximize" else -1.0
+        vals = [
+            t.objective_value(obj)
+            for t in trials
+            if t.condition.is_completed_ok() and t.objective_value(obj) is not None
+        ]
+        if not vals:
+            return None
+        return sign * float(np.mean(vals))
+
+    def get_suggestions(
+        self, experiment: Experiment, count: int
+    ) -> list[TrialAssignmentSet]:
+        prev = self._round_trials(experiment, self.round - 1) if self.round else []
+        if prev:
+            if any(not t.condition.is_terminal() for t in prev):
+                raise SuggestionsNotReady(
+                    f"enas round {self.round - 1} still has trials running"
+                )
+            if (self.round - 1) not in self._trained_rounds:
+                reward = self._mean_reward(prev)
+                if reward is not None:
+                    for _ in range(self.train_steps):
+                        arc, _ = self._sample(self.state.params, self._next_key())
+                        self.state, _ = self._train_step(
+                            self.state, arc, np.float32(reward)
+                        )
+                self._trained_rounds.add(self.round - 1)
+
+        nn_config = json.dumps(
+            {
+                "num_layers": self.num_layers,
+                "operations": self.operations,
+            }
+        )
+        out = []
+        for _ in range(count):
+            arc, _ = self._sample(self.state.params, self._next_key())
+            out.append(
+                TrialAssignmentSet(
+                    assignments=[
+                        ParameterAssignment("architecture", json.dumps(arc_to_json(arc))),
+                        ParameterAssignment("nn_config", nn_config),
+                    ],
+                    labels={ROUND_LABEL: str(self.round)},
+                )
+            )
+        self.round += 1
+        return out

@@ -1,0 +1,49 @@
+"""ENAS child-training trial workload.
+
+Parity with the reference trial image
+(``examples/v1beta1/trial-images/enas-cnn-cifar10/RunTrial.py:52-100``): build
+the CNN from the ``architecture``/``nn_config`` parameters, train for N
+epochs, report ``Validation-Accuracy`` per epoch — here via the trial
+context instead of stdout lines, on a JAX mesh instead of MirroredStrategy.
+"""
+
+from __future__ import annotations
+
+import json
+
+from katib_tpu.models.data import load_cifar10
+from katib_tpu.models.mnist import train_classifier
+from katib_tpu.nas.enas.child import child_from_arc
+from katib_tpu.nas.enas.controller import arc_from_json
+
+
+def enas_trial(ctx) -> None:
+    arch = json.loads(ctx.params["architecture"])
+    nn_config = json.loads(ctx.params["nn_config"])
+    num_layers = int(nn_config["num_layers"])
+    operations = nn_config.get("operations")
+
+    arc = arc_from_json(arch, num_layers)
+    model = child_from_arc(
+        arc,
+        operations=operations,
+        channels=int(ctx.params.get("channels", 24)),
+        num_classes=int(ctx.params.get("num_classes", 10)),
+    )
+    dataset = load_cifar10(
+        int(ctx.params.get("n_train", 8192)), int(ctx.params.get("n_test", 2048))
+    )
+
+    def report(epoch, accuracy, loss):
+        return ctx.report(step=epoch, accuracy=accuracy, loss=loss)
+
+    train_classifier(
+        model,
+        dataset,
+        lr=float(ctx.params.get("lr", 0.05)),
+        epochs=int(ctx.params.get("num_epochs", 3)),
+        batch_size=int(ctx.params.get("batch_size", 128)),
+        optimizer="momentum",
+        mesh=ctx.mesh,
+        report=report,
+    )

@@ -1,0 +1,17 @@
+from katib_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
+    data_sharding,
+    make_mesh,
+    replicate,
+    replicated,
+    shard_batch,
+)
+from katib_tpu.parallel.train import (  # noqa: F401
+    TrainState,
+    accuracy,
+    cross_entropy_loss,
+    make_eval_step,
+    make_train_step,
+)

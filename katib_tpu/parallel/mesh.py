@@ -1,0 +1,92 @@
+"""Device-mesh construction and sharding helpers.
+
+The reference has no tensor/data parallelism of its own — it delegates
+distributed trials to external operators (TFJob/PyTorchJob, SURVEY.md §2.4).
+Here parallelism is first-class: every trial trains on a ``jax.sharding.Mesh``
+and the orchestrator decides how the chips are partitioned between trials.
+
+Axis convention (reserved up front so HP/NAS search over large models can
+shard without API changes):
+
+- ``data``    — batch dimension (DP); gradients all-reduce over ICI
+- ``model``   — tensor parallelism (TP) for wide layers
+- ``seq``     — sequence/context parallelism (ring attention / Ulysses)
+
+A mesh with size-1 axes compiles to exactly the same XLA program as an
+unsharded one, so single-chip trials use the same code path as v5e-64 runs.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+
+
+def make_mesh(
+    axis_sizes: Mapping[str, int] | None = None,
+    devices: Sequence[Any] | None = None,
+) -> Mesh:
+    """Build a mesh over ``devices`` (default: all).
+
+    ``axis_sizes`` maps axis name -> size; one axis may be -1 to absorb the
+    remaining devices.  Default: a 1-D data mesh over every device, i.e. pure
+    data parallelism.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    n = len(devs)
+    if axis_sizes is None:
+        axis_sizes = {DATA_AXIS: n}
+    names = tuple(axis_sizes)
+    sizes = list(axis_sizes.values())
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one axis may be -1")
+    if -1 in sizes:
+        known = math.prod(s for s in sizes if s != -1)
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        sizes[sizes.index(-1)] = n // known
+    if math.prod(sizes) != n:
+        raise ValueError(f"mesh {dict(zip(names, sizes))} != {n} devices")
+    grid = np.asarray(devs).reshape(sizes)
+    return Mesh(grid, axis_names=names)
+
+
+def data_sharding(mesh: Mesh, *, extra_dims: int = 1) -> NamedSharding:
+    """Sharding for a batch: leading dim split over ``data`` (and ``seq`` if
+    the mesh has one), remaining dims replicated."""
+    spec = [DATA_AXIS] + [None] * extra_dims
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def shard_batch(batch, mesh: Mesh):
+    """Place a pytree of arrays with leading batch dims onto the mesh's data
+    axis.  Batch size must divide by the data-axis size (callers pad)."""
+
+    def place(x):
+        x = np.asarray(x) if not hasattr(x, "ndim") else x
+        spec = PartitionSpec(DATA_AXIS, *([None] * (x.ndim - 1)))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(place, batch)
+
+
+def replicate(tree, mesh: Mesh):
+    """Replicate a pytree (parameters, opt state) across the whole mesh."""
+    sharding = replicated(mesh)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)
+
+
+def local_mesh_size(mesh: Mesh, axis: str = DATA_AXIS) -> int:
+    return mesh.shape[axis] if axis in mesh.shape else 1

@@ -1,0 +1,105 @@
+"""Generic sharded training-step machinery.
+
+The compute path the reference delegates to PyTorch-CUDA inside trial
+containers (``darts-cnn-cifar10/run_trial.py:85-96``) is here a jitted,
+mesh-sharded JAX function: parameters replicated (or model-sharded), batch
+split over the ``data`` axis, gradient all-reduce inserted by GSPMD over ICI.
+There is no NCCL analog to manage — sharding annotations ARE the
+communication backend.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from katib_tpu.parallel.mesh import DATA_AXIS, replicated
+
+
+class TrainState(NamedTuple):
+    """Minimal train state (flax's TrainState without the apply_fn closure so
+    it stays a plain pytree for checkpointing)."""
+
+    step: jnp.ndarray
+    params: Any
+    opt_state: Any
+
+    @classmethod
+    def create(cls, params, tx: optax.GradientTransformation) -> "TrainState":
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=tx.init(params),
+        )
+
+
+def make_train_step(
+    loss_fn: Callable[..., jnp.ndarray],
+    tx: optax.GradientTransformation,
+    mesh: Mesh | None = None,
+    donate: bool = True,
+    grad_clip_norm: float | None = None,
+) -> Callable:
+    """Build ``step(state, batch) -> (state, metrics)``, jitted and sharded.
+
+    ``loss_fn(params, batch) -> scalar loss`` (or ``(loss, aux)`` with
+    ``has_aux`` inferred from the return).  With a mesh, params/opt-state are
+    replicated and the batch is split on the data axis; XLA inserts the
+    gradient all-reduce.
+    """
+
+    def step(state: TrainState, batch) -> tuple[TrainState, dict]:
+        def wrapped(params):
+            out = loss_fn(params, batch)
+            if isinstance(out, tuple):
+                return out
+            return out, {}
+
+        (loss, aux), grads = jax.value_and_grad(wrapped, has_aux=True)(state.params)
+        if grad_clip_norm is not None:
+            gnorm = optax.global_norm(grads)
+            scale = jnp.minimum(1.0, grad_clip_norm / (gnorm + 1e-6))
+            grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        metrics = {"loss": loss, **aux}
+        return TrainState(state.step + 1, params, opt_state), metrics
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+    state_sharding = replicated(mesh)
+    batch_sharding = NamedSharding(mesh, PartitionSpec(DATA_AXIS))
+    return jax.jit(
+        step,
+        in_shardings=(state_sharding, batch_sharding),
+        out_shardings=(state_sharding, state_sharding),
+        donate_argnums=(0,) if donate else (),
+    )
+
+
+def make_eval_step(
+    metric_fn: Callable[..., dict],
+    mesh: Mesh | None = None,
+) -> Callable:
+    """Build ``eval(params, batch) -> metrics`` jitted with batch sharding."""
+    if mesh is None:
+        return jax.jit(metric_fn)
+    return jax.jit(
+        metric_fn,
+        in_shardings=(replicated(mesh), NamedSharding(mesh, PartitionSpec(DATA_AXIS))),
+        out_shardings=replicated(mesh),
+    )
+
+
+def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+
+
+def accuracy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    return jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))

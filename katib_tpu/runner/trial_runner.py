@@ -182,6 +182,11 @@ class _FileTailSource(_LineSource):
         *complete, self._buffer = self._buffer.split("\n")
         return complete
 
+    def drain(self) -> list[str]:
+        """Flush a trailing line without a newline (process has exited)."""
+        rest, self._buffer = self._buffer, ""
+        return [rest] if rest.strip() else []
+
 
 def _run_blackbox(
     trial: Trial,
@@ -200,12 +205,17 @@ def _run_blackbox(
     )
 
     def parse(lines: list[str]):
-        try:
-            if collector.kind is MetricsCollectorKind.JSONL:
-                return parse_json_lines(lines, metric_names)
-            return parse_text_lines(lines, metric_names, filters)
-        except ValueError:
-            return []
+        if collector.kind is MetricsCollectorKind.JSONL:
+            # per-line so one malformed line (partial flush, stray diagnostic)
+            # doesn't discard the valid lines polled in the same batch
+            out = []
+            for line in lines:
+                try:
+                    out.extend(parse_json_lines([line], metric_names))
+                except ValueError:
+                    continue
+            return out
+        return parse_text_lines(lines, metric_names, filters)
 
     try:
         proc = subprocess.Popen(
@@ -245,8 +255,12 @@ def _run_blackbox(
         time.sleep(0.05)
     rc = proc.wait()
 
-    # final sweep for lines written right before exit
-    for log in parse(source.poll()):
+    # final sweep for lines written right before exit (including a last line
+    # with no trailing newline)
+    final_lines = source.poll()
+    if isinstance(source, _FileTailSource):
+        final_lines += source.drain()
+    for log in parse(final_lines):
         store.report(trial.name, [log])
 
     if early_stopped:

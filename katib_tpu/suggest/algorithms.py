@@ -1,4 +1,9 @@
-"""Import side-effect module: registers all built-in suggesters."""
+"""Import side-effect module: registers the built-in CPU suggesters.
+
+The NAS suggesters (darts/enas) pull in jax/flax/optax; they are registered
+lazily by ``base.make_suggester`` so that plain HP-tuning experiments (and
+black-box orchestrator processes) never pay the JAX import/backend-init cost.
+"""
 
 from katib_tpu.suggest import bayesopt  # noqa: F401
 from katib_tpu.suggest import cmaes  # noqa: F401
@@ -8,3 +13,9 @@ from katib_tpu.suggest import pbt  # noqa: F401
 from katib_tpu.suggest import random_search  # noqa: F401
 from katib_tpu.suggest import sobol  # noqa: F401
 from katib_tpu.suggest import tpe  # noqa: F401
+
+#: registered on first use by ``base.make_suggester``
+LAZY_ALGORITHMS = {
+    "darts": "katib_tpu.nas.darts.service",
+    "enas": "katib_tpu.nas.enas.service",
+}

@@ -140,12 +140,16 @@ def make_suggester(spec: ExperimentSpec) -> Suggester:
     analog of the composer resolving the algorithm image from KatibConfig
     (``composer.go:72``)."""
     # import for registration side effects
+    import importlib
+
     from katib_tpu.suggest import algorithms  # noqa: F401
 
     name = spec.algorithm.name
+    if name not in _REGISTRY and name in algorithms.LAZY_ALGORITHMS:
+        importlib.import_module(algorithms.LAZY_ALGORITHMS[name])
     if name not in _REGISTRY:
         raise SuggesterError(
-            f"unknown algorithm {name!r}; registered: {sorted(_REGISTRY)}"
+            f"unknown algorithm {name!r}; registered: {sorted(registered_algorithms())}"
         )
     return _REGISTRY[name](spec)
 
@@ -153,4 +157,4 @@ def make_suggester(spec: ExperimentSpec) -> Suggester:
 def registered_algorithms() -> list[str]:
     from katib_tpu.suggest import algorithms  # noqa: F401
 
-    return sorted(_REGISTRY)
+    return sorted(set(_REGISTRY) | set(algorithms.LAZY_ALGORITHMS))

@@ -1,15 +1,24 @@
 """Test configuration: force an 8-device virtual CPU platform so sharding
 tests exercise real multi-device meshes without TPU hardware (the driver's
-dryrun uses the same mechanism)."""
+dryrun uses the same mechanism).
+
+Note: this image boots an `axon` TPU PJRT plugin from sitecustomize whose
+register() forces the platform, so JAX_PLATFORMS must be overridden via
+jax.config *after* import, not just through the environment.
+"""
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,261 @@
+"""DARTS + ENAS tests (tiny configs; CPU-backend JAX per conftest —
+the reference's CI strategy of CPU trial-image variants, SURVEY.md §4)."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from katib_tpu.core.types import (
+    AlgorithmSpec,
+    Experiment,
+    ExperimentSpec,
+    FeasibleSpace,
+    GraphConfig,
+    NasConfig,
+    NasOperation,
+    ObjectiveSpec,
+    ObjectiveType,
+    ParameterSpec,
+    ParameterType,
+)
+from katib_tpu.suggest import SuggesterError, SuggestionsNotReady, make_suggester
+from katib_tpu.suggest.base import SearchExhausted
+from tests.helpers import complete_trial
+
+TINY_PRIMS = ("none", "skip_connection", "separable_convolution_3x3", "max_pooling_3x3")
+
+
+def nas_config():
+    return NasConfig(
+        graph_config=GraphConfig(num_layers=4),
+        operations=(
+            NasOperation(
+                "separable_convolution",
+                parameters=(
+                    ParameterSpec(
+                        "filter_size",
+                        ParameterType.CATEGORICAL,
+                        FeasibleSpace(list=("3", "5")),
+                    ),
+                ),
+            ),
+            NasOperation("skip_connection"),
+        ),
+    )
+
+
+def nas_spec(algo="darts", settings=None):
+    return ExperimentSpec(
+        name=f"nas-{algo}",
+        objective=ObjectiveSpec(
+            type=ObjectiveType.MAXIMIZE, objective_metric_name="accuracy"
+        ),
+        algorithm=AlgorithmSpec(name=algo, settings=settings or {}),
+        nas_config=nas_config(),
+        train_fn=lambda ctx: None,
+    )
+
+
+class TestDartsModel:
+    def test_forward_shapes(self):
+        from katib_tpu.nas.darts.model import DartsNetwork, init_alphas
+
+        net = DartsNetwork(
+            primitives=TINY_PRIMS, init_channels=8, num_layers=2, num_classes=4,
+            remat=False,
+        )
+        alphas = init_alphas(4, len(TINY_PRIMS), jax.random.PRNGKey(0))
+        x = np.zeros((2, 8, 8, 3), np.float32)
+        w = net.init(jax.random.PRNGKey(1), x, alphas)
+        logits = net.apply(w, x, alphas)
+        assert logits.shape == (2, 4)
+        assert logits.dtype == np.float32
+
+    def test_genotype_extraction(self):
+        from katib_tpu.nas.darts.model import Alphas, extract_genotype
+
+        import jax.numpy as jnp
+
+        k = sum(j + 2 for j in range(4))
+        # make 'none' dominant everywhere: genotype must never select it
+        normal = jnp.zeros((k, len(TINY_PRIMS))).at[:, 0].set(5.0)
+        geno = extract_genotype(
+            Alphas(normal=normal, reduce=normal), TINY_PRIMS, n_nodes=4
+        )
+        for node in geno.normal:
+            assert len(node) == 2
+            for op, edge in node:
+                assert op != "none"
+
+    def test_search_step_improves_loss(self):
+        from katib_tpu.models.data import synthetic_classification
+        from katib_tpu.nas.darts import DartsHyper, run_darts_search
+
+        ds = synthetic_classification(128, 64, (8, 8, 3), 4, seed=1, noise=0.3)
+        out = run_darts_search(
+            ds,
+            primitives=TINY_PRIMS,
+            num_layers=2,
+            init_channels=8,
+            num_epochs=2,
+            batch_size=32,
+            hyper=DartsHyper(unrolled=False),
+            seed=0,
+        )
+        assert out["history"][-1]["train_loss"] < out["history"][0]["train_loss"] * 1.2
+        assert len(out["genotype"].normal) == 4
+
+
+class TestDartsService:
+    def test_single_trial_contract(self):
+        spec = nas_spec("darts", settings={"num_epochs": "3"})
+        s = make_suggester(spec)
+        exp = Experiment(spec=spec)
+        proposals = s.get_suggestions(exp, 5)
+        assert len(proposals) == 1  # exactly one trial, reference parity
+        params = proposals[0].as_dict()
+        merged = json.loads(params["algorithm-settings"])
+        assert merged["num_epochs"] == "3"  # user override wins
+        assert merged["w_lr"] == 0.025  # default preserved
+        prims = json.loads(params["search-space"])
+        assert prims == [
+            "separable_convolution_3x3",
+            "separable_convolution_5x5",
+            "skip_connection",
+        ]
+        assert params["num-layers"] == "4"
+        complete_trial(exp, proposals[0], 0.9)
+        with pytest.raises(SearchExhausted):
+            s.get_suggestions(exp, 1)
+
+    def test_settings_validation(self):
+        with pytest.raises(SuggesterError, match="num_epochs"):
+            make_suggester(nas_spec("darts", settings={"num_epochs": "-3"}))
+        with pytest.raises(SuggesterError, match="w_lr"):
+            make_suggester(nas_spec("darts", settings={"w_lr": "abc"}))
+
+
+class TestEnasController:
+    def test_sample_shapes_and_determinism(self):
+        from katib_tpu.nas.enas.controller import (
+            ControllerConfig,
+            init_controller,
+            sample_arc,
+        )
+
+        cfg = ControllerConfig(num_layers=5, num_operations=6)
+        params = init_controller(cfg, jax.random.PRNGKey(0))
+        arc, stats = sample_arc(params, cfg, jax.random.PRNGKey(1))
+        assert arc.ops.shape == (5,)
+        assert arc.skips.shape == (5, 5)
+        # lower-triangular: no skip from future layers
+        sk = np.asarray(arc.skips)
+        assert np.all(np.triu(sk) == 0)
+        arc2, _ = sample_arc(params, cfg, jax.random.PRNGKey(1))
+        assert np.array_equal(np.asarray(arc.ops), np.asarray(arc2.ops))
+
+    def test_reinforce_learns_preference(self):
+        from katib_tpu.nas.enas.controller import ControllerConfig, make_reinforce
+
+        cfg = ControllerConfig(
+            num_layers=3,
+            num_operations=3,
+            learning_rate=5e-3,
+            entropy_weight=None,
+            skip_weight=None,
+            baseline_decay=0.9,
+        )
+        init, train_step, sample = make_reinforce(cfg)
+        state = init(jax.random.PRNGKey(0))
+        key = jax.random.PRNGKey(1)
+        for _ in range(200):
+            key, k = jax.random.split(key)
+            arc, _ = sample(state.params, k)
+            reward = float(np.mean(np.asarray(arc.ops) == 1))
+            state, _ = train_step(state, arc, np.float32(reward))
+        counts = np.zeros(3)
+        for _ in range(40):
+            key, k = jax.random.split(key)
+            arc, _ = sample(state.params, k)
+            for o in np.asarray(arc.ops):
+                counts[o] += 1
+        assert counts[1] == counts.max()
+
+    def test_arc_json_roundtrip(self):
+        from katib_tpu.nas.enas.controller import (
+            Arc,
+            arc_from_json,
+            arc_to_json,
+        )
+        import jax.numpy as jnp
+
+        arc = Arc(
+            ops=jnp.array([2, 0, 1], jnp.int32),
+            skips=jnp.array(
+                [[0, 0, 0], [1, 0, 0], [0, 1, 0]], jnp.int32
+            ),
+        )
+        data = arc_to_json(arc)
+        assert data == [[2], [0, 1], [1, 0, 1]]
+        back = arc_from_json(data, 3)
+        assert np.array_equal(np.asarray(back.ops), np.asarray(arc.ops))
+        assert np.array_equal(np.asarray(back.skips), np.asarray(arc.skips))
+
+
+class TestEnasChild:
+    def test_child_builds_and_runs(self):
+        from katib_tpu.nas.enas.child import child_from_arc
+        from katib_tpu.nas.enas.controller import arc_from_json
+
+        arc = arc_from_json([[0], [1, 1], [2, 0, 1], [3, 1, 1, 0]], 4)
+        model = child_from_arc(arc, channels=8, num_classes=4)
+        x = np.zeros((2, 16, 16, 3), np.float32)
+        params = model.init(jax.random.PRNGKey(0), x)
+        logits = model.apply(params, x)
+        assert logits.shape == (2, 4)
+
+
+class TestEnasService:
+    def test_round_lifecycle(self):
+        spec = nas_spec(
+            "enas",
+            settings={"controller_train_steps": "2", "controller_hidden_size": "16"},
+        )
+        s = make_suggester(spec)
+        exp = Experiment(spec=spec)
+        round0 = s.get_suggestions(exp, 3)
+        assert len(round0) == 3
+        for p in round0:
+            params = p.as_dict()
+            arch = json.loads(params["architecture"])
+            assert len(arch) == 4
+            cfgd = json.loads(params["nn_config"])
+            assert cfgd["num_layers"] == 4
+            assert p.labels["enas-round"] == "0"
+        # round 1 blocked until round 0 completes
+        from katib_tpu.core.types import TrialCondition
+
+        t = complete_trial(exp, round0[0], 0.0, condition=TrialCondition.RUNNING)
+        t.observation = None
+        with pytest.raises(SuggestionsNotReady):
+            s.get_suggestions(exp, 3)
+        t.condition = TrialCondition.SUCCEEDED
+        from katib_tpu.core.types import Metric, Observation
+
+        t.observation = Observation(metrics=[Metric(name="accuracy", value=0.6, latest=0.6)])
+        for p in round0[1:]:
+            complete_trial(exp, p, 0.5)
+        round1 = s.get_suggestions(exp, 2)
+        assert all(p.labels["enas-round"] == "1" for p in round1)
+
+    def test_state_dict_roundtrip(self):
+        spec = nas_spec("enas", settings={"controller_hidden_size": "16"})
+        s = make_suggester(spec)
+        exp = Experiment(spec=spec)
+        s.get_suggestions(exp, 1)
+        data = s.state_dict()
+        s2 = make_suggester(spec)
+        s2.load_state_dict(data)
+        assert s2.round == 1

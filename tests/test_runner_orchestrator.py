@@ -306,23 +306,25 @@ class TestMedianStopIntegration:
 
         spec = make_spec(
             train_fn=trainer,
+            algorithm=AlgorithmSpec(name="random", settings={"random_state": "3"}),
             parameters=[
                 ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min=-1.0, max=1.0)),
             ],
             early_stopping=EarlyStoppingSpec(
                 name="medianstop",
-                settings={"min_trials_required": "3", "start_step": "4"},
+                settings={"min_trials_required": "2", "start_step": "4"},
             ),
-            max_trial_count=14,
+            max_trial_count=20,
             parallel_trial_count=2,
         )
         exp = Orchestrator().run(spec)
         assert exp.condition is ExperimentCondition.MAX_TRIALS_REACHED
         stopped = exp.early_stopped_count
-        # with half the space bad, some trials must get early-stopped
+        # with half the space bad and 20 seeded trials, bad trials past the
+        # first few must get median-stopped
         assert stopped >= 1
         # early-stopped trials count toward completion (reference parity)
-        assert exp.completed_count == 14
+        assert exp.completed_count == 20
 
 
 class TestExecutionRegressions:
@@ -432,3 +434,43 @@ class TestExecutionRegressions:
         exp = Orchestrator().run(spec)
         assert exp.condition is ExperimentCondition.GOAL_REACHED
         assert time.time() - t0 < 8.0  # nowhere near the 10s sleep loops
+
+
+class TestBlackboxTailRegressions:
+    def test_jsonl_bad_line_does_not_drop_batch(self, tmp_path):
+        path = str(tmp_path / "m.jsonl")
+        code = (
+            f"f = open({path!r}, 'w')\n"
+            "f.write('{\"accuracy\": 0.4}\\nnot json at all\\n{\"accuracy\": 0.8}\\n')\n"
+            "f.close()\n"
+        )
+        trial = Trial(
+            name="jb1",
+            spec=TrialSpec(
+                command=["python", "-c", code],
+                metrics_collector=MetricsCollectorSpec(
+                    kind=MetricsCollectorKind.JSONL, path=path
+                ),
+            ),
+        )
+        store = MemoryObservationStore()
+        res = run_trial(trial, store, OBJ)
+        assert res.condition is TrialCondition.SUCCEEDED
+        assert [l.value for l in store.get("jb1", "accuracy")] == [0.4, 0.8]
+
+    def test_file_final_line_without_newline(self, tmp_path):
+        path = str(tmp_path / "m.log")
+        code = f"f = open({path!r}, 'w'); f.write('accuracy=0.93'); f.close()"
+        trial = Trial(
+            name="nl1",
+            spec=TrialSpec(
+                command=["python", "-c", code],
+                metrics_collector=MetricsCollectorSpec(
+                    kind=MetricsCollectorKind.FILE, path=path
+                ),
+            ),
+        )
+        store = MemoryObservationStore()
+        res = run_trial(trial, store, OBJ)
+        assert res.condition is TrialCondition.SUCCEEDED
+        assert [l.value for l in store.get("nl1", "accuracy")] == [0.93]

@@ -170,3 +170,24 @@ class TestJsonParser:
         assert not objective_reported(logs, "accuracy")
         logs += parse_json_lines(['{"accuracy": 0.5}'], ["accuracy"])
         assert objective_reported(logs, "accuracy")
+
+
+class TestDataSeedDeterminism:
+    def test_synthetic_dataset_stable_across_processes(self):
+        import subprocess
+        import sys
+
+        code = (
+            "import sys; sys.path.insert(0, '/root/repo');"
+            "from katib_tpu.models.data import load_mnist;"
+            "ds = load_mnist(64, 16); print(float(ds.x_train.sum()))"
+        )
+        outs = {
+            subprocess.run(
+                [sys.executable, "-c", code], capture_output=True, text=True,
+                env={"PATH": "/usr/bin:/bin", "PYTHONHASHSEED": str(i),
+                     "PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"},
+            ).stdout.strip()
+            for i in (1, 2)
+        }
+        assert len(outs) == 1  # same dataset regardless of hash salt
