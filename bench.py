@@ -1,0 +1,100 @@
+"""Benchmark: DARTS supernet bilevel-search throughput on the local accelerator.
+
+Times the flagship compute path — the second-order (unrolled + Hessian
+correction) DARTS search step at the reference's CIFAR-10 configuration
+(batch 64, 8 layers, 16 init channels; ``darts-cnn-cifar10/run_trial.py``) —
+and prints ONE JSON line.
+
+``vs_baseline`` compares images/sec against the reference PyTorch trial image
+running the same second-order search on its CI GPU class (~250 img/s on a
+V100-16GB for batch-64 second-order DARTS, derived from the DARTS paper's
+1-day/4-epoch-search economics; the reference repo publishes no numbers —
+BASELINE.json ``published`` is empty).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+
+REFERENCE_IMG_PER_SEC = 250.0
+
+# full size by default (the driver's TPU run); BENCH_SMALL=1 shrinks the
+# supernet so a CPU smoke test finishes in seconds
+_SMALL = os.environ.get("BENCH_SMALL", "") not in ("", "0")
+BATCH = 8 if _SMALL else 64
+NUM_LAYERS = 2 if _SMALL else 8
+INIT_CHANNELS = 4 if _SMALL else 16
+N_NODES = 2 if _SMALL else 4
+WARMUP_STEPS = 1 if _SMALL else 3
+TIMED_STEPS = 3 if _SMALL else 20
+
+
+def main() -> None:
+    # the axon PJRT plugin ignores the JAX_PLATFORMS env var; honor it
+    # explicitly so BENCH_SMALL=1 JAX_PLATFORMS=cpu smoke tests work
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        jax.config.update("jax_platforms", want)
+
+    from katib_tpu.nas.darts.architect import (
+        DartsHyper,
+        init_search_state,
+        make_search_step,
+    )
+    from katib_tpu.nas.darts.model import DartsNetwork, init_alphas
+    from katib_tpu.nas.darts.ops import DEFAULT_PRIMITIVES
+    from katib_tpu.parallel.train import cross_entropy_loss
+
+    net = DartsNetwork(
+        primitives=DEFAULT_PRIMITIVES,
+        init_channels=INIT_CHANNELS,
+        num_layers=NUM_LAYERS,
+        n_nodes=N_NODES,
+        num_classes=10,
+    )
+    key = jax.random.PRNGKey(0)
+    k_init, k_alpha, k_data = jax.random.split(key, 3)
+    alphas = init_alphas(N_NODES, len(DEFAULT_PRIMITIVES), k_alpha)
+    x = jax.random.normal(k_data, (BATCH, 32, 32, 3), jnp.float32)
+    y = jax.random.randint(jax.random.fold_in(k_data, 1), (BATCH,), 0, 10)
+    weights = net.init(k_init, x[:1], alphas)
+
+    def loss_fn(w, a, batch):
+        xb, yb = batch
+        return cross_entropy_loss(net.apply(w, xb, a), yb)
+
+    hyper = DartsHyper(total_steps=TIMED_STEPS, unrolled=True)
+    step = make_search_step(loss_fn, hyper, mesh=None)
+    state = init_search_state(weights, alphas, hyper)
+    batch = (x, y)
+
+    for _ in range(WARMUP_STEPS):
+        state, metrics = step(state, batch, batch)
+    jax.block_until_ready(state)
+
+    t0 = time.perf_counter()
+    for _ in range(TIMED_STEPS):
+        state, metrics = step(state, batch, batch)
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+
+    img_per_sec = BATCH * TIMED_STEPS / dt
+    print(
+        json.dumps(
+            {
+                "metric": "darts_bilevel_search_throughput",
+                "value": round(float(img_per_sec), 2),
+                "unit": "images/sec",
+                "vs_baseline": round(float(img_per_sec) / REFERENCE_IMG_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

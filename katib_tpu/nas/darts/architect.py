@@ -19,7 +19,6 @@ program — no host round-trips inside the epoch loop.
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable, NamedTuple
 
 import jax
@@ -35,7 +34,6 @@ class SearchState(NamedTuple):
     step: jnp.ndarray
     weights: Any
     alphas: Alphas
-    w_opt: Any
     a_opt: Any
     velocity: Any  # momentum buffer mirror for the virtual step
 
@@ -75,9 +73,9 @@ def make_search_step(
         )
 
     def clip(grads):
-        gnorm = optax.global_norm(grads)
-        scale = jnp.minimum(1.0, hyper.w_grad_clip / (gnorm + 1e-6))
-        return tmap(lambda g: g * scale, grads), gnorm
+        from katib_tpu.parallel.train import clip_by_global_norm
+
+        return clip_by_global_norm(grads, hyper.w_grad_clip)
 
     grad_w = jax.grad(loss_fn, argnums=0)
     grad_a = jax.grad(loss_fn, argnums=1)
@@ -136,7 +134,6 @@ def make_search_step(
             step=state.step + 1,
             weights=weights,
             alphas=alphas,
-            w_opt=state.w_opt,
             a_opt=a_opt,
             velocity=velocity,
         )
@@ -176,7 +173,6 @@ def init_search_state(
         step=jnp.zeros((), jnp.int32),
         weights=weights,
         alphas=alphas,
-        w_opt=(),
         a_opt=a_tx.init(alphas),
         velocity=tmap(jnp.zeros_like, weights),
     )

@@ -156,16 +156,15 @@ def darts_trial(ctx) -> None:
 
     n_train = int(settings.get("n_train", 8192))
     dataset = load_cifar10(n_train, int(settings.get("n_test", 2048)))
-    hyper = DartsHyper(
-        w_lr=float(settings.get("w_lr", 0.025)),
-        w_lr_min=float(settings.get("w_lr_min", 0.001)),
-        w_momentum=float(settings.get("w_momentum", 0.9)),
-        w_weight_decay=float(settings.get("w_weight_decay", 3e-4)),
-        w_grad_clip=float(settings.get("w_grad_clip", 5.0)),
-        alpha_lr=float(settings.get("alpha_lr", 3e-4)),
-        alpha_weight_decay=float(settings.get("alpha_weight_decay", 1e-3)),
-        unrolled=parse_bool(settings.get("unrolled", True)),
-    )
+    # DartsHyper's field defaults are the single source of truth; settings
+    # override field-by-field (total_steps is derived from the schedule)
+    overrides = {}
+    for name in DartsHyper._fields:
+        if name == "total_steps" or name not in settings:
+            continue
+        raw = settings[name]
+        overrides[name] = parse_bool(raw) if name == "unrolled" else float(raw)
+    hyper = DartsHyper(**overrides)
 
     def report(epoch, accuracy, loss):
         return ctx.report(step=epoch, accuracy=accuracy, loss=loss)

@@ -25,16 +25,17 @@ from katib_tpu.suggest.base import (
     register,
 )
 
+from katib_tpu.nas.darts.architect import DartsHyper
+
 DEFAULT_SETTINGS: dict[str, object] = {
-    # reference defaults ``darts/service.py:118-135``
+    # reference defaults ``darts/service.py:118-135``; the optimizer-side
+    # values come from DartsHyper so the trial and service can't drift
     "num_epochs": 50,
-    "w_lr": 0.025,
-    "w_lr_min": 0.001,
-    "w_momentum": 0.9,
-    "w_weight_decay": 3e-4,
-    "w_grad_clip": 5.0,
-    "alpha_lr": 3e-4,
-    "alpha_weight_decay": 1e-3,
+    **{
+        k: v
+        for k, v in DartsHyper._field_defaults.items()
+        if k not in ("total_steps", "unrolled")
+    },
     "batch_size": 128,
     "init_channels": 16,
     "num_nodes": 4,

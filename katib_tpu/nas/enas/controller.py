@@ -142,7 +142,7 @@ def _trace(params: ControllerParams, cfg: ControllerConfig, arc: Arc, key=None):
             logp_all = jax.nn.log_softmax(sk_logits, axis=-1)
             logp_sk = jnp.take_along_axis(logp_all, sk[:, None], axis=1).sum()
             log_prob = log_prob + logp_sk
-            entropy = entropy + jax.lax.stop_gradient(-logp_sk * jnp.exp(-(-logp_sk)))
+            entropy = entropy + jax.lax.stop_gradient(-logp_sk * jnp.exp(logp_sk))
             # KL(skip distribution || target rate) penalty (Controller.py:156-159)
             skip_prob = jax.nn.sigmoid(sk_logits)
             kl = (skip_prob * jnp.log(skip_prob / skip_targets)).sum()

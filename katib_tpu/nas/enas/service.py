@@ -55,6 +55,15 @@ _SETTING_TYPES = {
     "controller_train_steps": int,
 }
 
+# settings that accept the reference's "None" sentinel to disable the feature
+# (``enas/AlgorithmSettings.py`` checkNumericAndNone list)
+_NULLABLE_SETTINGS = {
+    "controller_temperature",
+    "controller_tanh_const",
+    "controller_entropy_weight",
+    "controller_skip_weight",
+}
+
 
 def _operations_from_nas_config(nas_config) -> list[str]:
     ops: list[str] = []
@@ -78,11 +87,16 @@ class EnasSuggester(Suggester):
             raise SuggesterError("enas requires nas_config with operations")
         s = spec.algorithm.settings
         for name, caster in _SETTING_TYPES.items():
-            if name in s and s[name] != "None":
-                try:
-                    caster(s[name])
-                except (TypeError, ValueError):
-                    raise SuggesterError(f"{name} must be {caster.__name__}") from None
+            if name not in s:
+                continue
+            if s[name] == "None":
+                if name not in _NULLABLE_SETTINGS:
+                    raise SuggesterError(f"{name} does not accept None")
+                continue
+            try:
+                caster(s[name])
+            except (TypeError, ValueError):
+                raise SuggesterError(f"{name} must be {caster.__name__}") from None
         if "controller_baseline_decay" in s and not (
             0.0 <= float(s["controller_baseline_decay"]) <= 1.0
         ):

@@ -24,11 +24,12 @@ def enas_trial(ctx) -> None:
     operations = nn_config.get("operations")
 
     arc = arc_from_json(arch, num_layers)
+    kwargs = {"operations": tuple(operations)} if operations else {}
     model = child_from_arc(
         arc,
-        operations=operations,
         channels=int(ctx.params.get("channels", 24)),
         num_classes=int(ctx.params.get("num_classes", 10)),
+        **kwargs,
     )
     dataset = load_cifar10(
         int(ctx.params.get("n_train", 8192)), int(ctx.params.get("n_test", 2048))

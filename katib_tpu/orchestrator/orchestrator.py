@@ -61,6 +61,18 @@ class Orchestrator:
         self.workdir = workdir
         self.mesh = mesh
         self.poll_interval = poll_interval
+        # external stop request (client delete / shutdown): sticky so a stop
+        # issued before run() enters its loop is not lost; each run() has its
+        # own wind-down event for in-flight trials
+        self._stop_requested = threading.Event()
+        self._stop_event = threading.Event()
+
+    def stop(self) -> None:
+        """Request the experiment wind down (the reference's experiment
+        deletion path, ``experiment_controller.go:362-403``).  Sticky: a
+        stopped orchestrator will not run further experiments."""
+        self._stop_requested.set()
+        self._stop_event.set()
 
     # -- public API ---------------------------------------------------------
 
@@ -91,16 +103,33 @@ class Orchestrator:
         exhausted = False
         stalled_polls = 0
         futures: dict[cf.Future, Trial] = {}
-        # signals in-flight trials to wind down once the experiment is decided
-        # (the reference deletes running trial jobs, experiment_controller.go:362)
+        # per-run wind-down signal for in-flight trials, set on a terminal
+        # verdict or an external stop() (the reference deletes running trial
+        # jobs, experiment_controller.go:362).  A fresh run() (resume) gets a
+        # fresh event; the sticky _stop_requested flag survives so a stop()
+        # racing run() startup is never lost.
         stop_event = threading.Event()
         self._stop_event = stop_event
+        if self._stop_requested.is_set():
+            stop_event.set()
 
         with cf.ThreadPoolExecutor(
             max_workers=spec.parallel_trial_count, thread_name_prefix=f"trial-{exp.name}"
         ) as pool:
             while True:
                 self._harvest(exp, futures)
+                if self._stop_requested.is_set():
+                    stop_event.set()
+                if stop_event.is_set():
+                    # external stop: cancel queued work, wait out running
+                    # trials (they observe the event via their context)
+                    self._cancel_pending(futures)
+                    self._harvest(exp, futures, wait_running=True)
+                    exp.condition = ExperimentCondition.FAILED
+                    exp.message = "experiment stopped"
+                    exp.completion_time = time.time()
+                    exp.update_optimal()
+                    return exp
                 verdict = self._check_terminal(exp, exhausted, futures)
                 if verdict is not None:
                     stop_event.set()

@@ -37,6 +37,14 @@ class TrainState(NamedTuple):
         )
 
 
+def clip_by_global_norm(grads, max_norm: float):
+    """Scale ``grads`` so their global norm is at most ``max_norm``; returns
+    ``(clipped, norm)`` (the raw norm is a useful training metric)."""
+    gnorm = optax.global_norm(grads)
+    scale = jnp.minimum(1.0, max_norm / (gnorm + 1e-6))
+    return jax.tree_util.tree_map(lambda g: g * scale, grads), gnorm
+
+
 def make_train_step(
     loss_fn: Callable[..., jnp.ndarray],
     tx: optax.GradientTransformation,
@@ -61,9 +69,7 @@ def make_train_step(
 
         (loss, aux), grads = jax.value_and_grad(wrapped, has_aux=True)(state.params)
         if grad_clip_norm is not None:
-            gnorm = optax.global_norm(grads)
-            scale = jnp.minimum(1.0, grad_clip_norm / (gnorm + 1e-6))
-            grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+            grads, _ = clip_by_global_norm(grads, grad_clip_norm)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         metrics = {"loss": loss, **aux}

@@ -156,6 +156,11 @@ class _StdoutSource(_LineSource):
             out, self._lines = self._lines, []
         return out
 
+    def join(self, timeout: float) -> None:
+        """Wait for the reader to hit EOF so the final poll sees every line
+        the process printed before exiting."""
+        self._thread.join(timeout)
+
 
 class _FileTailSource(_LineSource):
     """Tails the metrics file the trial writes (sidecar watch parity,
@@ -256,7 +261,9 @@ def _run_blackbox(
     rc = proc.wait()
 
     # final sweep for lines written right before exit (including a last line
-    # with no trailing newline)
+    # with no trailing newline); the reader thread must reach EOF first or
+    # buffered lines race the sweep and a reported metric is lost
+    stdout_source.join(timeout=5.0)
     final_lines = source.poll()
     if isinstance(source, _FileTailSource):
         final_lines += source.drain()

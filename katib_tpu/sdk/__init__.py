@@ -1,0 +1,6 @@
+"""User-facing SDK (reference ``sdk/python/v1beta1/kubeflow/katib``)."""
+
+from katib_tpu.sdk import search
+from katib_tpu.sdk.client import KatibClient, make_experiment_spec, tune
+
+__all__ = ["KatibClient", "make_experiment_spec", "search", "tune"]

@@ -1,0 +1,161 @@
+"""SDK surface tests: search helpers, tune(), KatibClient lifecycle."""
+
+from __future__ import annotations
+
+import math
+
+import pytest
+
+from katib_tpu.core.types import ExperimentCondition, ParameterType
+from katib_tpu.sdk import KatibClient, make_experiment_spec, search, tune
+
+
+def _quadratic(params):
+    # max at x=2, y=-1
+    return -((params["x"] - 2.0) ** 2) - (params["y"] + 1.0) ** 2
+
+
+class TestSearchHelpers:
+    def test_double(self):
+        p = search.make_parameters({"lr": search.double(0.001, 0.1)})[0]
+        assert p.name == "lr"
+        assert p.type is ParameterType.DOUBLE
+        assert p.feasible.min == 0.001 and p.feasible.max == 0.1
+
+    def test_loguniform(self):
+        p = search.make_parameters({"lr": search.loguniform(1e-5, 1e-1)})[0]
+        assert p.feasible.is_log_scaled()
+
+    def test_int(self):
+        p = search.make_parameters({"units": search.int_(16, 256, step=16)})[0]
+        assert p.type is ParameterType.INT
+        assert p.feasible.step == 16
+
+    def test_categorical_and_discrete(self):
+        ps = search.make_parameters(
+            {
+                "opt": search.categorical(["sgd", "adam"]),
+                "bs": search.discrete([32, 64, 128]),
+            }
+        )
+        assert ps[0].type is ParameterType.CATEGORICAL
+        assert ps[1].type is ParameterType.DISCRETE
+
+    def test_literal_shorthands(self):
+        ps = search.make_parameters({"lr": (0.01, 0.1), "opt": ["sgd", "adam"]})
+        assert ps[0].type is ParameterType.DOUBLE
+        assert ps[1].type is ParameterType.CATEGORICAL
+
+    def test_bad_entry(self):
+        with pytest.raises(TypeError):
+            search.make_parameters({"x": object()})
+
+
+class TestTune:
+    def test_tune_returns_optimal(self, tmp_path):
+        exp = tune(
+            _quadratic,
+            {"x": search.double(0.0, 4.0), "y": search.double(-3.0, 1.0)},
+            name="tune-quad",
+            algorithm="tpe",
+            max_trial_count=20,
+            parallel_trial_count=4,
+            workdir=str(tmp_path),
+        )
+        assert exp.condition is ExperimentCondition.MAX_TRIALS_REACHED
+        assert exp.optimal is not None
+        assert exp.optimal.objective_value > -8.0  # found something decent
+
+    def test_tune_goal_short_circuit(self, tmp_path):
+        exp = tune(
+            lambda p: 1.0,
+            {"x": search.double(0.0, 1.0)},
+            name="tune-goal",
+            goal=0.5,
+            max_trial_count=50,
+            workdir=str(tmp_path),
+        )
+        assert exp.condition is ExperimentCondition.GOAL_REACHED
+        assert len(exp.trials) < 50
+
+    def test_tune_minimize(self, tmp_path):
+        exp = tune(
+            lambda p: (p["x"] - 1.0) ** 2,
+            {"x": search.double(0.0, 2.0)},
+            name="tune-min",
+            objective_type="minimize",
+            algorithm="random",
+            max_trial_count=15,
+            workdir=str(tmp_path),
+        )
+        assert exp.optimal.objective_value < 0.5
+
+    def test_objective_returning_dict(self, tmp_path):
+        exp = tune(
+            lambda p: {"objective": p["x"], "aux": 1.0},
+            {"x": search.double(0.0, 1.0)},
+            name="tune-dict",
+            additional_metric_names=("aux",),
+            max_trial_count=3,
+            workdir=str(tmp_path),
+        )
+        t = next(iter(exp.trials.values()))
+        assert t.observation.get("aux") is not None
+
+    def test_objective_with_ctx(self, tmp_path):
+        def obj(params, ctx):
+            for step in range(3):
+                ctx.report(step=step, objective=params["x"] * (step + 1))
+
+        exp = tune(
+            obj,
+            {"x": search.double(0.5, 1.0)},
+            name="tune-ctx",
+            max_trial_count=3,
+            workdir=str(tmp_path),
+        )
+        assert exp.optimal is not None
+
+
+class TestClient:
+    def test_async_lifecycle(self, tmp_path):
+        client = KatibClient(workdir=str(tmp_path))
+        spec = make_experiment_spec(
+            "cl-exp",
+            {"x": search.double(0.0, 1.0)},
+            objective=lambda p: p["x"],
+            max_trial_count=6,
+            parallel_trial_count=2,
+        )
+        client.create_experiment(spec)
+        exp = client.wait_for_experiment_condition("cl-exp", timeout=60)
+        assert client.is_experiment_succeeded("cl-exp")
+        assert exp.condition is ExperimentCondition.MAX_TRIALS_REACHED
+        best = client.get_optimal_hyperparameters("cl-exp")
+        assert "x" in best and 0.0 <= best["x"] <= 1.0
+        assert len(client.get_trials("cl-exp")) == 6
+        assert client.list_experiments() == [exp]
+        client.delete_experiment("cl-exp")
+        assert client.list_experiments() == []
+
+    def test_duplicate_running_rejected(self, tmp_path):
+        client = KatibClient(workdir=str(tmp_path))
+        spec = make_experiment_spec(
+            "cl-dup",
+            {"x": search.double(0.0, 1.0)},
+            objective=lambda p: p["x"],
+            max_trial_count=200,
+            parallel_trial_count=1,
+        )
+        client.create_experiment(spec)
+        with pytest.raises(ValueError):
+            client.create_experiment(spec)
+        client.delete_experiment("cl-dup")
+
+    def test_requires_exactly_one_entrypoint(self):
+        with pytest.raises(ValueError):
+            make_experiment_spec("x", {}, objective=None, command=None)
+        with pytest.raises(ValueError):
+            make_experiment_spec(
+                "x", {}, objective=lambda p: 0.0, command=["echo", "hi"]
+            )

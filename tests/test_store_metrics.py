@@ -182,12 +182,14 @@ class TestDataSeedDeterminism:
             "from katib_tpu.models.data import load_mnist;"
             "ds = load_mnist(64, 16); print(float(ds.x_train.sum()))"
         )
-        outs = {
-            subprocess.run(
+        outs = set()
+        for i in (1, 2):
+            proc = subprocess.run(
                 [sys.executable, "-c", code], capture_output=True, text=True,
                 env={"PATH": "/usr/bin:/bin", "PYTHONHASHSEED": str(i),
                      "PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"},
-            ).stdout.strip()
-            for i in (1, 2)
-        }
+            )
+            assert proc.returncode == 0, proc.stderr
+            float(proc.stdout.strip())  # a real checksum, not empty output
+            outs.add(proc.stdout.strip())
         assert len(outs) == 1  # same dataset regardless of hash salt
