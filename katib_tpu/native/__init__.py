@@ -1,0 +1,39 @@
+"""Native (C++) runtime components.
+
+- ``NativeObservationStore`` — in-RAM append-log metrics engine (ctypes).
+- ``parse_text_lines_native`` — C++ TEXT metrics parser (default filter).
+- ``spawn_db_manager`` / ``RemoteObservationStore`` — standalone metrics
+  daemon + wire client, the cross-process parity of the reference's
+  DB-manager gRPC service.
+
+Everything degrades gracefully: ``native_available()`` is False when no C++
+toolchain is present and callers fall back to the pure-Python backends.
+"""
+
+from katib_tpu.native.build import build_error, ensure_built, native_available
+
+__all__ = [
+    "NativeObservationStore",
+    "RemoteObservationStore",
+    "build_error",
+    "ensure_built",
+    "native_available",
+    "parse_text_lines_native",
+    "spawn_db_manager",
+]
+
+
+def __getattr__(name):  # lazy: importing the package must not trigger a build
+    if name == "NativeObservationStore":
+        from katib_tpu.native.store import NativeObservationStore
+
+        return NativeObservationStore
+    if name == "parse_text_lines_native":
+        from katib_tpu.native.store import parse_text_lines_native
+
+        return parse_text_lines_native
+    if name in ("RemoteObservationStore", "spawn_db_manager"):
+        from katib_tpu.native import dbmanager
+
+        return getattr(dbmanager, name)
+    raise AttributeError(name)

@@ -1,0 +1,114 @@
+"""Build/load machinery for the native runtime.
+
+``ensure_built()`` compiles ``src/`` with the vendored Makefile into
+``build/`` the first time it's needed (or when sources changed) and caches
+the result; everything degrades gracefully — callers use
+``native_available()`` and fall back to the pure-Python implementations when
+no C++ toolchain exists.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD = os.path.join(_DIR, "build")
+LIB_PATH = os.path.join(_BUILD, "libkatibnative.so")
+DBMANAGER_PATH = os.path.join(_BUILD, "katib-db-manager")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_build_error: str | None = None
+
+
+def _stale() -> bool:
+    targets = (LIB_PATH, DBMANAGER_PATH)
+    if not all(os.path.exists(t) for t in targets):
+        return True
+    try:
+        newest_src = max(
+            os.path.getmtime(os.path.join(_DIR, "src", f))
+            for f in os.listdir(os.path.join(_DIR, "src"))
+        )
+    except (OSError, ValueError):
+        # prebuilt artifacts shipped without src/: usable as-is
+        return False
+    return any(os.path.getmtime(t) < newest_src for t in targets)
+
+
+def ensure_built() -> bool:
+    """Compile if needed; returns True when the native artifacts exist."""
+    global _build_error
+    with _lock:
+        if _build_error is not None:
+            return False
+        if not _stale():
+            return True
+        try:
+            proc = subprocess.run(
+                ["make", "-C", _DIR],
+                capture_output=True,
+                text=True,
+                timeout=120,
+            )
+        except (OSError, subprocess.TimeoutExpired) as e:
+            _build_error = str(e)
+            return False
+        if proc.returncode != 0:
+            _build_error = proc.stderr[-2000:]
+            return False
+        return True
+
+
+def build_error() -> str | None:
+    return _build_error
+
+
+def native_available() -> bool:
+    return ensure_built()
+
+
+def load_lib() -> ctypes.CDLL:
+    """Load (building if necessary) and declare the C ABI."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not ensure_built():
+        raise RuntimeError(f"native build failed: {_build_error}")
+    lib = ctypes.CDLL(LIB_PATH)
+
+    c = ctypes
+    lib.kt_store_new.restype = c.c_void_p
+    lib.kt_store_new.argtypes = []
+    lib.kt_store_free.argtypes = [c.c_void_p]
+    lib.kt_store_report.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_char_p, c.c_double, c.c_double, c.c_int64,
+    ]
+    lib.kt_store_report_batch.argtypes = [
+        c.c_void_p, c.c_char_p, c.c_int32,
+        c.POINTER(c.c_char_p), c.POINTER(c.c_double),
+        c.POINTER(c.c_double), c.POINTER(c.c_int64),
+    ]
+    lib.kt_store_get.restype = c.c_void_p
+    lib.kt_store_get.argtypes = [c.c_void_p, c.c_char_p, c.c_char_p]
+    lib.kt_store_delete.argtypes = [c.c_void_p, c.c_char_p]
+    lib.kt_store_total.restype = c.c_int64
+    lib.kt_store_total.argtypes = [c.c_void_p]
+    lib.kt_store_trial_names.restype = c.c_void_p
+    lib.kt_store_trial_names.argtypes = [c.c_void_p]
+    lib.kt_query_len.restype = c.c_int32
+    lib.kt_query_len.argtypes = [c.c_void_p]
+    lib.kt_query_names_blob.restype = c.c_char_p
+    lib.kt_query_names_blob.argtypes = [c.c_void_p]
+    lib.kt_query_values.argtypes = [c.c_void_p, c.POINTER(c.c_double)]
+    lib.kt_query_timestamps.argtypes = [c.c_void_p, c.POINTER(c.c_double)]
+    lib.kt_query_steps.argtypes = [c.c_void_p, c.POINTER(c.c_int64)]
+    lib.kt_query_free.argtypes = [c.c_void_p]
+    lib.kt_parse_text.restype = c.c_void_p
+    lib.kt_parse_text.argtypes = [c.c_char_p, c.c_char_p]
+
+    _lib = lib
+    return lib

@@ -1,0 +1,172 @@
+"""Client + spawn helper for the native katib-db-manager daemon.
+
+The daemon (``src/dbmanager.cc``) is the cross-process metrics front door —
+parity with the reference's standalone DB-manager gRPC service
+(``cmd/db-manager/v1beta1/main.go:51-70``).  Multi-host slice workers and
+black-box trials in other processes report through ``RemoteObservationStore``;
+in-process trials bypass it entirely.
+
+Wire protocol: length-prefixed little-endian frames (documented in
+``dbmanager.cc``).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import subprocess
+import threading
+import time
+from typing import Iterable
+
+from katib_tpu.core.types import MetricLog
+from katib_tpu.native.build import DBMANAGER_PATH, ensure_built
+from katib_tpu.store.base import ObservationStore
+
+_OP_REPORT, _OP_GET, _OP_DELETE, _OP_PING = 1, 2, 3, 4
+
+
+def _str16(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack("<H", len(b)) + b
+
+
+class RemoteObservationStore(ObservationStore):
+    """Observation store speaking the db-manager wire protocol over one
+    persistent socket (reconnects on failure)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 6789, timeout: float = 10.0):
+        self.host, self.port, self.timeout = host, port, timeout
+        self._lock = threading.Lock()
+        self._sock: socket.socket | None = None
+
+    # -- wire ---------------------------------------------------------------
+
+    def _connect(self) -> socket.socket:
+        sock = socket.create_connection((self.host, self.port), timeout=self.timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    def _recv_exact(self, sock: socket.socket, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("db-manager closed connection")
+            buf += chunk
+        return buf
+
+    def _call(self, payload: bytes) -> bytes:
+        with self._lock:
+            for attempt in (0, 1):  # one reconnect retry on a stale socket
+                if self._sock is None:
+                    self._sock = self._connect()
+                sent = False
+                try:
+                    self._sock.sendall(struct.pack("<I", len(payload)) + payload)
+                    sent = True
+                    (rlen,) = struct.unpack("<I", self._recv_exact(self._sock, 4))
+                    resp = self._recv_exact(self._sock, rlen)
+                    break
+                except (OSError, ConnectionError):
+                    try:
+                        self._sock.close()
+                    finally:
+                        self._sock = None
+                    # Retrying is only safe when the frame never went out: a
+                    # send failure means the daemon saw at most a partial
+                    # frame (dropped, never processed).  After a successful
+                    # send the daemon may have processed the request even
+                    # though the reply was lost, and re-sending a REPORT
+                    # would duplicate metric points — surface the error.
+                    if attempt or sent:
+                        raise
+            if not resp or resp[0] != 0:
+                raise RuntimeError("db-manager rejected request")
+            return resp[1:]
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                self._sock.close()
+                self._sock = None
+
+    # -- ObservationStore contract ------------------------------------------
+
+    def report(self, trial_name: str, logs: Iterable[MetricLog]) -> None:
+        logs = list(logs)
+        if not logs:
+            return
+        parts = [struct.pack("<B", _OP_REPORT), _str16(trial_name),
+                 struct.pack("<I", len(logs))]
+        for l in logs:
+            parts.append(_str16(l.metric_name))
+            parts.append(struct.pack("<ddq", l.value, l.timestamp, l.step))
+        self._call(b"".join(parts))
+
+    def get(self, trial_name: str, metric_name: str | None = None) -> list[MetricLog]:
+        payload = (
+            struct.pack("<B", _OP_GET) + _str16(trial_name) + _str16(metric_name or "")
+        )
+        body = self._call(payload)
+        (n,) = struct.unpack_from("<I", body, 0)
+        off = 4
+        out: list[MetricLog] = []
+        for _ in range(n):
+            (nlen,) = struct.unpack_from("<H", body, off)
+            off += 2
+            name = body[off : off + nlen].decode()
+            off += nlen
+            value, ts, step = struct.unpack_from("<ddq", body, off)
+            off += 24
+            out.append(MetricLog(metric_name=name, value=value, timestamp=ts, step=step))
+        return out
+
+    def delete(self, trial_name: str) -> None:
+        self._call(struct.pack("<B", _OP_DELETE) + _str16(trial_name))
+
+    def ping(self) -> int:
+        """Liveness probe; returns the daemon's total stored point count."""
+        body = self._call(struct.pack("<B", _OP_PING))
+        (total,) = struct.unpack("<q", body)
+        return total
+
+
+class DbManagerHandle:
+    def __init__(self, proc: subprocess.Popen, host: str, port: int):
+        self.proc, self.host, self.port = proc, host, port
+
+    def client(self) -> RemoteObservationStore:
+        return RemoteObservationStore(self.host, self.port)
+
+    def stop(self) -> None:
+        self.proc.terminate()
+        try:
+            self.proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait()
+
+
+def spawn_db_manager(host: str = "127.0.0.1", port: int = 0) -> DbManagerHandle:
+    """Launch the daemon (port 0 = ephemeral); blocks until it listens."""
+    if not ensure_built():
+        from katib_tpu.native.build import build_error
+
+        raise RuntimeError(f"native build failed: {build_error()}")
+    proc = subprocess.Popen(
+        [DBMANAGER_PATH, "--host", host, "--port", str(port)],
+        stdout=subprocess.PIPE,
+        text=True,
+    )
+    assert proc.stdout is not None
+    deadline = time.monotonic() + 10.0
+    line = ""
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if line.startswith("LISTENING "):
+            return DbManagerHandle(proc, host, int(line.split()[1]))
+        if proc.poll() is not None:
+            break
+    proc.kill()
+    raise RuntimeError(f"db-manager failed to start (last output: {line!r})")

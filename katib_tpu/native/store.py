@@ -1,0 +1,109 @@
+"""ctypes wrappers over the native observation-log engine.
+
+``NativeObservationStore`` is the in-RAM hot-path backend (same
+Report/Get/Delete contract as the reference DB-manager,
+``pkg/db/v1beta1/common/kdb.go:23``); ``parse_text_lines_native`` is the C++
+TEXT parser used by the black-box metrics tail when the filter is the
+reference default (custom regex filters fall back to the Python parser).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Callable, Iterable, Sequence
+
+from katib_tpu.core.types import MetricLog
+from katib_tpu.native.build import load_lib
+from katib_tpu.store.base import ObservationStore
+
+
+def _drain_query(lib, q) -> list[MetricLog]:
+    try:
+        n = lib.kt_query_len(q)
+        if n == 0:
+            return []
+        blob = lib.kt_query_names_blob(q).decode()
+        names = blob.split("\n")
+        values = (ctypes.c_double * n)()
+        ts = (ctypes.c_double * n)()
+        steps = (ctypes.c_int64 * n)()
+        lib.kt_query_values(q, values)
+        lib.kt_query_timestamps(q, ts)
+        lib.kt_query_steps(q, steps)
+        return [
+            MetricLog(metric_name=names[i], value=values[i], timestamp=ts[i], step=steps[i])
+            for i in range(n)
+        ]
+    finally:
+        lib.kt_query_free(q)
+
+
+class NativeObservationStore(ObservationStore):
+    """C++ append-log backend; thread safety lives in the C++ mutex, the
+    Python side only guards its subscriber list."""
+
+    def __init__(self) -> None:
+        self._lib = load_lib()
+        self._handle = self._lib.kt_store_new()
+        self._sub_lock = threading.Lock()
+        self._subscribers: list[Callable[[str, MetricLog], None]] = []
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.kt_store_free(handle)
+            self._handle = None
+
+    def subscribe(self, fn: Callable[[str, MetricLog], None]) -> None:
+        with self._sub_lock:
+            self._subscribers.append(fn)
+
+    def report(self, trial_name: str, logs: Iterable[MetricLog]) -> None:
+        logs = list(logs)
+        if not logs:
+            return
+        n = len(logs)
+        metrics = (ctypes.c_char_p * n)(*[l.metric_name.encode() for l in logs])
+        values = (ctypes.c_double * n)(*[l.value for l in logs])
+        ts = (ctypes.c_double * n)(*[l.timestamp for l in logs])
+        steps = (ctypes.c_int64 * n)(*[l.step for l in logs])
+        self._lib.kt_store_report_batch(
+            self._handle, trial_name.encode(), n, metrics, values, ts, steps
+        )
+        with self._sub_lock:
+            subs = list(self._subscribers)
+        for fn in subs:
+            for log in logs:
+                fn(trial_name, log)
+
+    def get(self, trial_name: str, metric_name: str | None = None) -> list[MetricLog]:
+        q = self._lib.kt_store_get(
+            self._handle,
+            trial_name.encode(),
+            metric_name.encode() if metric_name else b"",
+        )
+        return _drain_query(self._lib, q)
+
+    def delete(self, trial_name: str) -> None:
+        self._lib.kt_store_delete(self._handle, trial_name.encode())
+
+    def total_points(self) -> int:
+        return self._lib.kt_store_total(self._handle)
+
+    def trial_names(self) -> list[str]:
+        q = self._lib.kt_store_trial_names(self._handle)
+        return [l.metric_name for l in _drain_query(self._lib, q)]
+
+
+def parse_text_lines_native(
+    lines: Sequence[str], metric_names: Sequence[str]
+) -> list[MetricLog]:
+    """Native counterpart of ``runner.metrics.parse_text_lines`` for the
+    default filter (``common/const.go:47`` semantics)."""
+    lib = load_lib()
+    # kt_parse_text takes a C string: strip stray NUL bytes (binary progress
+    # bars, corrupted output) so they can't truncate the buffer mid-line
+    text = "\n".join(lines).replace("\0", "").encode(errors="replace")
+    tracked = "\n".join(metric_names).replace("\0", "").encode(errors="replace")
+    return _drain_query(lib, lib.kt_parse_text(text, tracked))

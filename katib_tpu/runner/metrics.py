@@ -38,10 +38,16 @@ UNAVAILABLE_METRIC_VALUE = "unavailable"
 
 
 def _parse_rfc3339(token: str) -> float | None:
+    # RFC3339 requires a zone offset (the reference parses with Go
+    # time.RFC3339); naive/date-only tokens are rejected, which also keeps
+    # this parser in lockstep with the native C++ one.
     try:
-        return datetime.fromisoformat(token.replace("Z", "+00:00")).timestamp()
+        dt = datetime.fromisoformat(token.replace("Z", "+00:00"))
     except ValueError:
         return None
+    if dt.tzinfo is None:
+        return None
+    return dt.timestamp()
 
 
 def parse_text_lines(
@@ -117,6 +123,36 @@ def parse_json_lines(
                 continue
             out.append(MetricLog(metric_name=name, value=value, timestamp=ts, step=step))
     return out
+
+
+_native_parser = None
+_native_checked = False
+
+
+def parse_text_lines_fast(
+    lines: Sequence[str],
+    metric_names: Sequence[str],
+    filters: Sequence[str] = (),
+) -> list[MetricLog]:
+    """``parse_text_lines`` with the C++ fast path: the native parser handles
+    the default filter; custom regex filters stay in Python."""
+    global _native_parser, _native_checked
+    if filters:
+        return parse_text_lines(lines, metric_names, filters)
+    if not _native_checked:
+        _native_checked = True
+        try:
+            from katib_tpu.native import native_available
+
+            if native_available():
+                from katib_tpu.native.store import parse_text_lines_native
+
+                _native_parser = parse_text_lines_native
+        except Exception:
+            _native_parser = None
+    if _native_parser is not None:
+        return _native_parser(lines, metric_names)
+    return parse_text_lines(lines, metric_names)
 
 
 def objective_reported(logs: Sequence[MetricLog], objective_metric: str) -> bool:

@@ -35,7 +35,7 @@ from katib_tpu.core.types import (
 )
 from katib_tpu.earlystop.rules import RuleEvaluator
 from katib_tpu.runner.context import TrialContext, TrialEarlyStopped
-from katib_tpu.runner.metrics import parse_json_lines, parse_text_lines
+from katib_tpu.runner.metrics import parse_json_lines, parse_text_lines_fast
 from katib_tpu.store.base import ObservationStore
 
 
@@ -220,7 +220,7 @@ def _run_blackbox(
                 except ValueError:
                     continue
             return out
-        return parse_text_lines(lines, metric_names, filters)
+        return parse_text_lines_fast(lines, metric_names, filters)
 
     try:
         proc = subprocess.Popen(

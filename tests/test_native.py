@@ -1,0 +1,203 @@
+"""Native (C++) runtime: observation store, TEXT parser parity, db-manager
+daemon round-trips.  Mirrors the reference's DB + metrics-collector unit
+coverage (``pkg/db/v1beta1/mysql/mysql_test.go`` with go-sqlmock;
+``test/unit/v1beta1/metricscollector``) against the real compiled engine."""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from katib_tpu.core.types import (
+    MetricStrategy,
+    MetricStrategyType,
+    ObjectiveSpec,
+    ObjectiveType,
+)
+from katib_tpu.native import native_available
+from katib_tpu.runner.metrics import parse_text_lines
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="C++ toolchain unavailable"
+)
+
+
+@pytest.fixture()
+def store():
+    from katib_tpu.native import NativeObservationStore
+
+    return NativeObservationStore()
+
+
+class TestNativeStore:
+    def test_report_get_ordering(self, store):
+        store.report_point("t1", "loss", 0.5, step=1)
+        store.report_point("t1", "acc", 0.8, step=1)
+        store.report_point("t1", "loss", 0.3, step=2)
+        all_logs = store.get("t1")
+        assert [(l.metric_name, l.value) for l in all_logs] == [
+            ("loss", 0.5), ("acc", 0.8), ("loss", 0.3),
+        ]
+        assert [l.value for l in store.get("t1", "loss")] == [0.5, 0.3]
+        assert store.get("t1", "nope") == []
+        assert store.get("ghost") == []
+
+    def test_delete_and_totals(self, store):
+        store.report_point("a", "m", 1.0)
+        store.report_point("b", "m", 2.0)
+        assert store.total_points() == 2
+        assert store.trial_names() == ["a", "b"]
+        store.delete("a")
+        assert store.total_points() == 1
+        assert store.trial_names() == ["b"]
+        assert store.get("a") == []
+        store.delete("a")  # idempotent
+
+    def test_observation_for_strategies(self, store):
+        obj = ObjectiveSpec(
+            type=ObjectiveType.MAXIMIZE,
+            objective_metric_name="acc",
+            metric_strategies=(MetricStrategy("acc", MetricStrategyType.MAX),),
+        )
+        for v in (0.1, 0.9, 0.5):
+            store.report_point("t", "acc", v)
+        obs = store.observation_for("t", obj)
+        assert obs.get("acc").value == 0.9
+
+    def test_subscribers_fire(self, store):
+        seen = []
+        store.subscribe(lambda trial, log: seen.append((trial, log.value)))
+        store.report_point("t", "loss", 1.5)
+        assert seen == [("t", 1.5)]
+
+    def test_concurrent_reports(self, store):
+        def worker(i):
+            for j in range(200):
+                store.report_point(f"trial-{i}", "loss", float(j), step=j)
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert store.total_points() == 8 * 200
+        for i in range(8):
+            logs = store.get(f"trial-{i}", "loss")
+            assert [l.step for l in logs] == list(range(200))
+
+
+class TestNativeParserParity:
+    LINES = [
+        "2024-01-02T03:04:05Z loss=0.25 accuracy=0.9",
+        "2024-01-02T03:04:05.500+02:00 loss=1e-3",
+        "epoch 3 val_accuracy=0.75 accuracy = 0.5",
+        "no metrics here",
+        "loss=-2.5e2 garbage accuracy=+.75",
+        "loss=",
+        "loss==5",
+        "deep|metric-name=4.25",
+        "prefix_loss=9.9",
+    ]
+    NAMES = ["loss", "accuracy", "deep|metric-name"]
+
+    def test_matches_python_parser(self):
+        from katib_tpu.native import parse_text_lines_native
+
+        py = parse_text_lines(self.LINES, self.NAMES)
+        native = parse_text_lines_native(self.LINES, self.NAMES)
+        assert [(l.metric_name, l.value, l.timestamp) for l in native] == [
+            (l.metric_name, l.value, l.timestamp) for l in py
+        ]
+        # sanity on content, not just parity
+        assert [(l.metric_name, l.value) for l in native] == [
+            ("loss", 0.25), ("accuracy", 0.9),
+            ("loss", 1e-3),
+            ("accuracy", 0.5),
+            ("loss", -2.5e2), ("accuracy", 0.75),
+            ("deep|metric-name", 4.25),
+        ]
+        assert native[0].timestamp == 1704164645.0
+        # +02:00 offset subtracted
+        assert native[2].timestamp == 1704157445.5
+
+
+class TestDbManagerDaemon:
+    def test_round_trip(self):
+        from katib_tpu.native import spawn_db_manager
+
+        handle = spawn_db_manager()
+        try:
+            client = handle.client()
+            client.report_point("t1", "loss", 0.5, step=3)
+            client.report_point("t1", "acc", 0.9)
+            client.report_point("t2", "loss", 1.5)
+            assert [(l.metric_name, l.value, l.step) for l in client.get("t1")] == [
+                ("loss", 0.5, 3), ("acc", 0.9, -1),
+            ]
+            assert [l.value for l in client.get("t1", "loss")] == [0.5]
+            assert client.ping() == 3
+            client.delete("t1")
+            assert client.get("t1") == []
+            assert client.ping() == 1
+            client.close()
+        finally:
+            handle.stop()
+
+    def test_concurrent_clients(self):
+        from katib_tpu.native import spawn_db_manager
+
+        handle = spawn_db_manager()
+        try:
+            def worker(i):
+                c = handle.client()
+                for j in range(50):
+                    c.report_point("shared", "m", float(i * 50 + j))
+                c.close()
+
+            threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            probe = handle.client()
+            assert len(probe.get("shared", "m")) == 200
+            probe.close()
+        finally:
+            handle.stop()
+
+    def test_blackbox_trial_reports_through_daemon(self, tmp_path):
+        """A black-box subprocess trial with a RemoteObservationStore: the
+        full cross-process metrics path (trial → stdout scrape → wire →
+        daemon), the TPU-native analog of sidecar → gRPC → DB-manager."""
+        import sys
+
+        from katib_tpu.core.types import (
+            Trial,
+            TrialCondition,
+            TrialSpec,
+        )
+        from katib_tpu.native import spawn_db_manager
+        from katib_tpu.runner.trial_runner import run_trial
+
+        handle = spawn_db_manager()
+        try:
+            store = handle.client()
+            obj = ObjectiveSpec(
+                type=ObjectiveType.MINIMIZE, objective_metric_name="loss"
+            )
+            script = tmp_path / "train.py"
+            script.write_text(
+                "print('loss=0.5')\nprint('loss=0.25')\n"
+            )
+            trial = Trial(
+                name="bb-remote",
+                experiment_name="e",
+                spec=TrialSpec(command=[sys.executable, str(script)]),
+            )
+            result = run_trial(trial, store, obj)
+            assert result.condition is TrialCondition.SUCCEEDED
+            assert [l.value for l in store.get("bb-remote", "loss")] == [0.5, 0.25]
+            store.close()
+        finally:
+            handle.stop()
