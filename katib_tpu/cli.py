@@ -1,0 +1,223 @@
+"""``katib-tpu`` command-line interface (``python -m katib_tpu``).
+
+The CLI replaces the reference's UI backend + kubectl surface
+(``pkg/ui/v1beta1/backend.go:86-617``: list experiments, trial detail,
+metric logs) with local commands over the orchestrator's status journal and
+observation store:
+
+- ``run <experiment.yaml>``   create + run a (black-box) experiment to completion
+- ``list``                    experiments in the workdir with live counts
+- ``describe <experiment>``   trials, assignments, observations, optimal
+- ``metrics <experiment> <trial>``  raw metric log for one trial
+- ``doctor``                  environment report (devices, native runtime)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from katib_tpu.core.config import KatibConfig
+
+
+def _fmt_age(start: float, end: float) -> str:
+    if not start:
+        return "-"
+    secs = int((end or time.time()) - start)
+    if secs < 60:
+        return f"{secs}s"
+    if secs < 3600:
+        return f"{secs // 60}m{secs % 60:02d}s"
+    return f"{secs // 3600}h{(secs % 3600) // 60:02d}m"
+
+
+def _table(rows: list[list[str]], header: list[str]) -> str:
+    widths = [
+        max(len(str(r[i])) for r in [header] + rows) for i in range(len(header))
+    ]
+    lines = ["  ".join(str(h).ljust(w) for h, w in zip(header, widths))]
+    for r in rows:
+        lines.append("  ".join(str(c).ljust(w) for c, w in zip(r, widths)))
+    return "\n".join(lines)
+
+
+def cmd_run(args: argparse.Namespace) -> int:
+    from katib_tpu.sdk.yaml_spec import load_experiment_yaml
+
+    cfg = KatibConfig.load(args.config)
+    if args.workdir:
+        cfg.init.workdir = args.workdir
+    spec = load_experiment_yaml(args.experiment)
+    if spec.command is None and spec.train_fn is None:
+        print(
+            "error: experiment file defines no trial command "
+            "(spec.command or spec.trialTemplate.command)",
+            file=sys.stderr,
+        )
+        return 2
+    orch = cfg.make_orchestrator()
+    exp = orch.run(spec)
+    status = "ok" if exp.condition.value != "Failed" else "FAILED"
+    print(f"experiment {exp.name}: {exp.condition.value} ({exp.message}) [{status}]")
+    if exp.optimal is not None:
+        print(
+            f"optimal trial {exp.optimal.trial_name}: "
+            f"{exp.spec.objective.objective_metric_name}={exp.optimal.objective_value}"
+        )
+        for name, value in sorted(
+            {a.name: a.value for a in exp.optimal.assignments}.items()
+        ):
+            print(f"  {name} = {value}")
+    return 0 if exp.condition.value != "Failed" else 1
+
+
+def cmd_list(args: argparse.Namespace) -> int:
+    from katib_tpu.orchestrator.status import list_statuses
+
+    statuses = list_statuses(args.workdir)
+    if not statuses:
+        print(f"no experiments under {args.workdir}")
+        return 0
+    rows = []
+    for s in statuses:
+        counts = s.get("counts", {})
+        optimal = s.get("optimal") or {}
+        rows.append(
+            [
+                s.get("name", "?"),
+                s.get("condition", "?"),
+                s.get("algorithm", "?"),
+                f"{counts.get('succeeded', 0)}/{counts.get('trials', 0)}",
+                counts.get("failed", 0),
+                optimal.get("objective_value", "-"),
+                _fmt_age(s.get("start_time") or 0, s.get("completion_time") or 0),
+            ]
+        )
+    print(_table(rows, ["NAME", "STATUS", "ALGORITHM", "SUCCEEDED", "FAILED", "BEST", "AGE"]))
+    return 0
+
+
+def cmd_describe(args: argparse.Namespace) -> int:
+    from katib_tpu.orchestrator.status import read_status
+
+    s = read_status(args.workdir, args.experiment)
+    if s is None:
+        print(f"experiment {args.experiment!r} not found under {args.workdir}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(s, indent=2))
+        return 0
+    print(f"Name:       {s['name']}")
+    print(f"Status:     {s['condition']}  {s.get('message', '')}".rstrip())
+    print(f"Algorithm:  {s['algorithm']}")
+    goal = f" (goal {s['goal']})" if s.get("goal") is not None else ""
+    print(f"Objective:  {s['objective_type']} {s['objective_metric']}{goal}")
+    optimal = s.get("optimal")
+    if optimal:
+        print(
+            f"Optimal:    {optimal['trial_name']} -> {optimal['objective_value']}  "
+            + " ".join(f"{k}={v}" for k, v in sorted(optimal["assignments"].items()))
+        )
+    rows = []
+    for t in s.get("trials", {}).values():
+        obs = t.get("observation") or []
+        objective = next(
+            (m["value"] for m in obs if m["name"] == s["objective_metric"]), "-"
+        )
+        rows.append(
+            [
+                t["name"],
+                t["condition"],
+                objective,
+                " ".join(f"{k}={v}" for k, v in sorted(t["assignments"].items())),
+            ]
+        )
+    if rows:
+        print()
+        print(_table(rows, ["TRIAL", "STATUS", "OBJECTIVE", "ASSIGNMENTS"]))
+    return 0
+
+
+def cmd_metrics(args: argparse.Namespace) -> int:
+    cfg = KatibConfig.load(args.config)
+    store = cfg.store.make_store()
+    logs = store.get(args.trial)
+    if not logs:
+        print(
+            f"no metrics for trial {args.trial!r} in store backend "
+            f"{cfg.store.backend!r} (persisted stores only: sqlite/remote)",
+            file=sys.stderr,
+        )
+        return 1
+    for l in logs:
+        print(f"{l.timestamp:.3f}\t{l.step}\t{l.metric_name}\t{l.value}")
+    return 0
+
+
+def cmd_doctor(args: argparse.Namespace) -> int:
+    import jax
+
+    from katib_tpu.native import build_error, native_available
+
+    print(f"jax {jax.__version__}")
+    try:
+        devices = jax.devices()
+        print(f"devices: {len(devices)} x {devices[0].platform}")
+    except RuntimeError as e:
+        print(f"devices: unavailable ({e})")
+    if native_available():
+        print("native runtime: built")
+    else:
+        print(f"native runtime: unavailable ({build_error()})")
+    cfg = KatibConfig.load(args.config)
+    print(f"workdir: {cfg.init.workdir}")
+    print(f"store: {cfg.store.backend}")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="katib-tpu", description="TPU-native AutoML framework CLI"
+    )
+    parser.add_argument("--config", default=None, help="KatibConfig YAML path")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("run", help="run an experiment from a YAML spec")
+    p.add_argument("experiment")
+    p.add_argument("--workdir", default=None)
+    p.set_defaults(fn=cmd_run)
+
+    p = sub.add_parser("list", help="list experiments")
+    p.add_argument("--workdir", default="katib_runs")
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("describe", help="describe one experiment")
+    p.add_argument("experiment")
+    p.add_argument("--workdir", default="katib_runs")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_describe)
+
+    p = sub.add_parser("metrics", help="dump a trial's metric log")
+    p.add_argument("experiment")
+    p.add_argument("trial")
+    p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser("doctor", help="environment report")
+    p.set_defaults(fn=cmd_doctor)
+
+    args = parser.parse_args(argv)
+    try:
+        return args.fn(args)
+    except BrokenPipeError:
+        # output piped into e.g. `head`; suppress the noise and let the
+        # interpreter exit without re-raising on stdout flush
+        devnull = os.open(os.devnull, os.O_WRONLY)
+        os.dup2(devnull, sys.stdout.fileno())
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -358,6 +358,10 @@ class MetricsCollectorKind(str, enum.Enum):
     STDOUT = "StdOut"
     FILE = "File"
     JSONL = "JsonLines"
+    # TensorBoard event files written by the trial (reference
+    # TensorFlowEvent collector, ``common_types.go:212-215``); parsed after
+    # the trial exits by ``runner/tfevent.py`` — no TF dependency.
+    TFEVENT = "TensorFlowEvent"
     NONE = "None"
 
 

@@ -128,9 +128,21 @@ def validate_experiment(spec: ExperimentSpec) -> None:
     if spec.metrics_collector.kind in (
         MetricsCollectorKind.FILE,
         MetricsCollectorKind.JSONL,
+        MetricsCollectorKind.TFEVENT,
     ) and not spec.metrics_collector.path:
         errors.append(
             f"metrics collector kind {spec.metrics_collector.kind.value} requires a path"
+        )
+    if (
+        spec.early_stopping is not None
+        and spec.metrics_collector.kind is MetricsCollectorKind.TFEVENT
+    ):
+        # event files are parsed once after exit, so rules could never fire
+        # mid-run (the reference only wires early stopping into the
+        # line-based file collector, ``file-metricscollector/main.go:332``)
+        errors.append(
+            "early stopping requires a line-based metrics collector "
+            "(StdOut/File/JsonLines/Push), not TensorFlowEvent"
         )
     validate_command_template(spec, errors)
 

@@ -28,6 +28,7 @@ import os
 import secrets
 import threading
 import time
+import traceback
 
 from katib_tpu.core.types import (
     Experiment,
@@ -40,13 +41,14 @@ from katib_tpu.core.types import (
 )
 from katib_tpu.core.validation import validate_experiment
 from katib_tpu.earlystop.rules import make_early_stopper
-from katib_tpu.runner.trial_runner import run_trial
+from katib_tpu.runner.trial_runner import TrialResult, run_trial
 from katib_tpu.store.base import MemoryObservationStore, ObservationStore
 from katib_tpu.suggest.base import (
     SearchExhausted,
     SuggestionsNotReady,
     make_suggester,
 )
+from katib_tpu.utils import observability as obs
 
 
 class Orchestrator:
@@ -56,11 +58,20 @@ class Orchestrator:
         workdir: str = "katib_runs",
         mesh=None,
         poll_interval: float = 0.02,
+        config=None,
     ):
         self.store = store if store is not None else MemoryObservationStore()
         self.workdir = workdir
         self.mesh = mesh
         self.poll_interval = poll_interval
+        # KatibConfig (core/config.py): runtime registry of per-algorithm
+        # defaults + profiler flags, merged into specs at run() time — the
+        # analog of the reference resolving KatibConfig at reconcile time
+        # (``katibconfig/config.go:60``)
+        self.config = config
+        # jax.profiler is a process-global singleton; only one trial may
+        # trace at a time — others run unprofiled rather than crash
+        self._profile_lock = threading.Lock()
         # external stop request (client delete / shutdown): sticky so a stop
         # issued before run() enters its loop is not lost; each run() has its
         # own wind-down event for in-flight trials
@@ -82,6 +93,8 @@ class Orchestrator:
         ``experiment`` to resume (``ResumePolicy`` semantics: a completed
         experiment re-opens when ``max_trial_count`` was raised, reference
         ``experiment_controller.go:187-206``)."""
+        if self.config is not None:
+            spec = self.config.apply_to(spec)
         validate_experiment(spec)
         exp = experiment or Experiment(spec=spec)
         if experiment is not None:
@@ -100,6 +113,9 @@ class Orchestrator:
             early_stopper.bind_store(self.store)
 
         exp.condition = ExperimentCondition.RUNNING
+        obs.experiments_created.inc(algorithm=spec.algorithm.name)
+        obs.experiments_current.inc()
+        self._publish(exp)
         exhausted = False
         stalled_polls = 0
         futures: dict[cf.Future, Trial] = {}
@@ -113,9 +129,11 @@ class Orchestrator:
         if self._stop_requested.is_set():
             stop_event.set()
 
+        mesh = self._resolve_mesh(spec)
         with cf.ThreadPoolExecutor(
             max_workers=spec.parallel_trial_count, thread_name_prefix=f"trial-{exp.name}"
         ) as pool:
+          try:
             while True:
                 self._harvest(exp, futures)
                 if self._stop_requested.is_set():
@@ -129,6 +147,7 @@ class Orchestrator:
                     exp.message = "experiment stopped"
                     exp.completion_time = time.time()
                     exp.update_optimal()
+                    self._finish(exp)
                     return exp
                 verdict = self._check_terminal(exp, exhausted, futures)
                 if verdict is not None:
@@ -139,6 +158,7 @@ class Orchestrator:
                     exp.completion_time = time.time()
                     exp.update_optimal()
                     exp.message = self._terminal_message(verdict)
+                    self._finish(exp)
                     return exp
 
                 want = self._shortfall(exp, futures)
@@ -152,7 +172,7 @@ class Orchestrator:
                         pass
                     for proposal in proposals:
                         trial = self._materialize(exp, proposal, early_stopper, suggester)
-                        futures[pool.submit(self._execute, exp, trial)] = trial
+                        futures[pool.submit(self._execute, exp, trial, mesh)] = trial
 
                 # livelock guard: nothing running, nothing proposed, not
                 # exhausted — a buggy suggester would spin here forever
@@ -166,10 +186,24 @@ class Orchestrator:
                         )
                         exp.completion_time = time.time()
                         exp.update_optimal()
+                        self._finish(exp)
                         return exp
                 else:
                     stalled_polls = 0
                 time.sleep(self.poll_interval)
+          except Exception:
+            # bookkeeping must not be skipped on an orchestrator/suggester
+            # bug: wind down in-flight trials, record the failure, balance
+            # the experiments_current gauge, then surface the bug
+            stop_event.set()
+            self._cancel_pending(futures)
+            self._harvest(exp, futures, wait_running=True)
+            exp.condition = ExperimentCondition.FAILED
+            exp.message = "orchestrator error:\n" + traceback.format_exc(limit=20)
+            exp.completion_time = time.time()
+            exp.update_optimal()
+            self._finish(exp)
+            raise
 
     # -- internals ----------------------------------------------------------
 
@@ -199,16 +233,82 @@ class Orchestrator:
             checkpoint_dir=ckpt,
         )
         exp.trials[name] = trial
+        obs.trials_created.inc()
         return trial
 
-    def _execute(self, exp: Experiment, trial: Trial):
+    def _resolve_mesh(self, spec: ExperimentSpec):
+        """Explicit mesh wins; otherwise the config registry decides —
+        per-algorithm ``runtime.algorithms.<name>.mesh_axes`` over the
+        ``init.mesh_axes`` default (the analog of per-algorithm resource
+        registration, ``composer.go:72``)."""
+        if self.mesh is not None or self.config is None:
+            return self.mesh
+        axes = self.config.mesh_axes_for(spec.algorithm.name)
+        if not axes:
+            return None
+        import math as _math
+
+        import jax
+
+        from katib_tpu.parallel.mesh import make_mesh
+
+        # a trial mesh may cover a subset of the machine (multiple trials
+        # share the slice); take the first prod(axes) devices
+        want = _math.prod(axes.values())
+        return make_mesh(axes, devices=jax.devices()[:want])
+
+    def _execute(self, exp: Experiment, trial: Trial, mesh):
+        # invariant: never raises — _harvest calls f.result() bare
+        want_profile = self.config is not None and self.config.init.enable_profiler
+        if want_profile and self._profile_lock.acquire(blocking=False):
+            try:
+                import jax
+
+                trace_dir = os.path.join(trial.checkpoint_dir, "profile")
+                with jax.profiler.trace(trace_dir):
+                    return run_trial(
+                        trial, self.store, exp.spec.objective,
+                        mesh=mesh, stop_event=self._stop_event,
+                    )
+            except Exception:
+                return TrialResult(TrialCondition.FAILED, traceback.format_exc(limit=20))
+            finally:
+                self._profile_lock.release()
         return run_trial(
             trial,
             self.store,
             exp.spec.objective,
-            mesh=self.mesh,
+            mesh=mesh,
             stop_event=self._stop_event,
         )
+
+    def _finish(self, exp: Experiment) -> None:
+        """Terminal bookkeeping: observability counters + final status write
+        (reference ``prometheus_metrics.go`` experiment counters)."""
+        obs.experiments_current.dec()
+        if exp.condition is ExperimentCondition.FAILED:
+            obs.experiments_failed.inc(algorithm=exp.spec.algorithm.name)
+        else:
+            obs.experiments_succeeded.inc(algorithm=exp.spec.algorithm.name)
+        self._publish(exp)
+
+    _TRIAL_COUNTERS = {
+        TrialCondition.SUCCEEDED: obs.trials_succeeded,
+        TrialCondition.FAILED: obs.trials_failed,
+        TrialCondition.EARLY_STOPPED: obs.trials_early_stopped,
+        TrialCondition.KILLED: obs.trials_killed,
+        TrialCondition.METRICS_UNAVAILABLE: obs.trials_metrics_unavailable,
+    }
+
+    def _publish(self, exp: Experiment) -> None:
+        """Journal status for CLI/UI views (``status.json`` per experiment);
+        never lets a status-write failure kill the run loop."""
+        try:
+            from katib_tpu.orchestrator.status import write_status
+
+            write_status(exp, self.workdir)
+        except OSError:
+            pass
 
     def _harvest(
         self, exp: Experiment, futures: dict, wait_running: bool = False
@@ -221,6 +321,7 @@ class Orchestrator:
             if f.cancelled():
                 trial.condition = TrialCondition.KILLED
                 trial.completion_time = time.time()
+                obs.trials_killed.inc()
                 continue
             result = f.result()  # _execute never raises
             trial.condition = result.condition
@@ -235,7 +336,12 @@ class Orchestrator:
                 )
                 if trial.observation is None:
                     trial.condition = TrialCondition.METRICS_UNAVAILABLE
+            counter = self._TRIAL_COUNTERS.get(trial.condition)
+            if counter is not None:
+                counter.inc()
             exp.update_optimal()
+        if done:
+            self._publish(exp)
 
     @staticmethod
     def _budget_used(exp: Experiment) -> int:

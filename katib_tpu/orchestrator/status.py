@@ -1,0 +1,113 @@
+"""Experiment status persistence for CLI/UI views.
+
+The reference exposes experiment/trial status through CR status fields that
+the UI backend reads (``pkg/ui/v1beta1/backend.go:86-617``).  Here the
+orchestrator journals the same information to
+``<workdir>/<experiment>/status.json`` on every trial completion, so
+``katib-tpu list/describe`` (and any external dashboard) can watch progress
+without holding a reference to the running process.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+from katib_tpu.core.types import Experiment, Observation, Trial
+
+STATUS_FILE = "status.json"
+
+
+def _observation_to_dict(obs: Observation | None) -> list[dict] | None:
+    if obs is None:
+        return None
+    return [
+        {"name": m.name, "value": m.value, "min": m.min, "max": m.max, "latest": m.latest}
+        for m in obs.metrics
+    ]
+
+
+def trial_to_dict(trial: Trial) -> dict:
+    return {
+        "name": trial.name,
+        "condition": trial.condition.value,
+        "assignments": {a.name: a.value for a in trial.spec.assignments},
+        "labels": dict(trial.spec.labels),
+        "observation": _observation_to_dict(trial.observation),
+        "message": trial.message,
+        "start_time": trial.start_time,
+        "completion_time": trial.completion_time,
+        "checkpoint_dir": trial.checkpoint_dir,
+    }
+
+
+def experiment_to_dict(exp: Experiment) -> dict:
+    return {
+        "name": exp.name,
+        "condition": exp.condition.value,
+        "message": exp.message,
+        "algorithm": exp.spec.algorithm.name,
+        "objective_metric": exp.spec.objective.objective_metric_name,
+        "objective_type": exp.spec.objective.type.value,
+        "goal": exp.spec.objective.goal,
+        "start_time": exp.start_time,
+        "completion_time": exp.completion_time,
+        "counts": {
+            "trials": len(exp.trials),
+            "succeeded": exp.succeeded_count,
+            "failed": exp.failed_count,
+            "early_stopped": exp.early_stopped_count,
+            "metrics_unavailable": exp.metrics_unavailable_count,
+            "running": exp.running_count,
+        },
+        "optimal": (
+            None
+            if exp.optimal is None
+            else {
+                "trial_name": exp.optimal.trial_name,
+                "objective_value": exp.optimal.objective_value,
+                "assignments": {a.name: a.value for a in exp.optimal.assignments},
+            }
+        ),
+        "trials": {name: trial_to_dict(t) for name, t in exp.trials.items()},
+    }
+
+
+def write_status(exp: Experiment, workdir: str) -> str:
+    """Atomically write the experiment's status file; returns its path."""
+    exp_dir = os.path.join(workdir, exp.name)
+    os.makedirs(exp_dir, exist_ok=True)
+    path = os.path.join(exp_dir, STATUS_FILE)
+    fd, tmp = tempfile.mkstemp(dir=exp_dir, prefix=".status-", suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(experiment_to_dict(exp), f, indent=1, default=str)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    return path
+
+
+def read_status(workdir: str, experiment_name: str) -> dict | None:
+    path = os.path.join(workdir, experiment_name, STATUS_FILE)
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def list_statuses(workdir: str) -> list[dict]:
+    out = []
+    try:
+        entries = sorted(os.listdir(workdir))
+    except OSError:
+        return []
+    for name in entries:
+        status = read_status(workdir, name)
+        if status is not None:
+            out.append(status)
+    return out

@@ -208,8 +208,16 @@ def _run_blackbox(
         MetricsCollectorKind.FILE,
         MetricsCollectorKind.JSONL,
     )
+    # TFEvent summaries are parsed once after exit (reference tfevent
+    # collector semantics, ``tfevent-metricscollector/main.py:47-79``):
+    # event files are binary, so there is no live line stream to tail
+    tfevent_dir = (
+        collector.path if collector.kind is MetricsCollectorKind.TFEVENT else None
+    )
 
     def parse(lines: list[str]):
+        if tfevent_dir or collector.kind is MetricsCollectorKind.NONE:
+            return []  # metrics come from event files / nowhere, not stdout
         if collector.kind is MetricsCollectorKind.JSONL:
             # per-line so one malformed line (partial flush, stray diagnostic)
             # doesn't discard the valid lines polled in the same batch
@@ -269,6 +277,12 @@ def _run_blackbox(
         final_lines += source.drain()
     for log in parse(final_lines):
         store.report(trial.name, [log])
+    if tfevent_dir:
+        from katib_tpu.runner.tfevent import parse_tfevent_dir
+
+        logs = parse_tfevent_dir(tfevent_dir, metric_names)
+        if logs:
+            store.report(trial.name, logs)
 
     if early_stopped:
         return TrialResult(TrialCondition.EARLY_STOPPED, evaluator.triggered.describe())

@@ -1,0 +1,195 @@
+"""Katib-style Experiment YAML → ``ExperimentSpec``.
+
+Accepts the reference's Experiment CR shape (``apiVersion: kubeflow.org/...``
+``kind: Experiment`` with ``metadata.name`` + ``spec.{objective, algorithm,
+parameters, ...}`` — see ``examples/v1beta1/hp-tuning/random.yaml``) so
+existing Katib experiment files port with only the trialTemplate swapped for
+a ``command`` argv, plus an equivalent flat shape for new users.  Trials
+defined this way are black-box subprocess commands; white-box JAX ``train_fn``
+experiments are built in Python via the SDK.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import yaml
+
+from katib_tpu.core.types import (
+    AlgorithmSpec,
+    Distribution,
+    EarlyStoppingSpec,
+    ExperimentSpec,
+    FeasibleSpace,
+    MetricsCollectorKind,
+    MetricsCollectorSpec,
+    MetricStrategy,
+    MetricStrategyType,
+    ObjectiveSpec,
+    ObjectiveType,
+    ParameterSpec,
+    ParameterType,
+    ResumePolicy,
+)
+
+
+class SpecError(ValueError):
+    pass
+
+
+def _num(value: Any) -> float:
+    # the reference CR encodes feasibleSpace numbers as strings
+    return float(value)
+
+
+def _settings_list(raw: Any) -> dict[str, str]:
+    """algorithmSettings come as [{name, value}] in the CR; accept plain
+    mappings too."""
+    if raw is None:
+        return {}
+    if isinstance(raw, Mapping):
+        return {str(k): str(v) for k, v in raw.items()}
+    out: dict[str, str] = {}
+    for item in raw:
+        out[str(item["name"])] = str(item["value"])
+    return out
+
+
+def _parse_parameter(raw: Mapping[str, Any]) -> ParameterSpec:
+    try:
+        name = raw["name"]
+        ptype = ParameterType(raw.get("parameterType", raw.get("type")))
+    except (KeyError, ValueError) as e:
+        raise SpecError(f"bad parameter entry {raw!r}: {e}") from e
+    fs = raw.get("feasibleSpace", raw.get("feasible", {})) or {}
+    dist = fs.get("distribution", "uniform")
+    try:
+        distribution = Distribution(dist)
+    except ValueError as e:
+        raise SpecError(f"parameter {name!r}: unknown distribution {dist!r}") from e
+    values = fs.get("list")
+    if ptype in (ParameterType.DOUBLE, ParameterType.INT):
+        feasible = FeasibleSpace(
+            min=_num(fs["min"]) if "min" in fs else None,
+            max=_num(fs["max"]) if "max" in fs else None,
+            step=_num(fs["step"]) if fs.get("step") is not None else None,
+            distribution=distribution,
+        )
+    else:
+        if values is None:
+            raise SpecError(f"parameter {name!r}: {ptype.value} requires a list")
+        if ptype is ParameterType.DISCRETE:
+            values = tuple(_num(v) for v in values)
+        else:
+            values = tuple(str(v) for v in values)
+        feasible = FeasibleSpace(list=values, distribution=distribution)
+    return ParameterSpec(name=name, type=ptype, feasible=feasible)
+
+
+def _parse_objective(raw: Mapping[str, Any]) -> ObjectiveSpec:
+    try:
+        otype = ObjectiveType(raw["type"])
+        metric = raw["objectiveMetricName"]
+    except (KeyError, ValueError) as e:
+        raise SpecError(f"bad objective {raw!r}: {e}") from e
+    strategies = tuple(
+        MetricStrategy(name=s["name"], value=MetricStrategyType(s["value"]))
+        for s in raw.get("metricStrategies") or ()
+    )
+    return ObjectiveSpec(
+        type=otype,
+        objective_metric_name=metric,
+        goal=float(raw["goal"]) if raw.get("goal") is not None else None,
+        additional_metric_names=tuple(raw.get("additionalMetricNames") or ()),
+        metric_strategies=strategies,
+    )
+
+
+def _parse_collector(raw: Mapping[str, Any] | None) -> MetricsCollectorSpec:
+    if not raw:
+        return MetricsCollectorSpec(kind=MetricsCollectorKind.STDOUT)
+    # CR shape: {collector: {kind}, source: {filter: {metricsFormat: [...]},
+    # fileSystemPath: {path, kind}}}; flat shape: {kind, path, filter}
+    kind_raw = (raw.get("collector") or {}).get("kind", raw.get("kind", "StdOut"))
+    try:
+        kind = MetricsCollectorKind(kind_raw)
+    except ValueError as e:
+        raise SpecError(f"unknown metrics collector kind {kind_raw!r}") from e
+    source = raw.get("source") or {}
+    formats = (source.get("filter") or {}).get("metricsFormat") or []
+    path = (source.get("fileSystemPath") or {}).get("path") or raw.get("path")
+    filter_ = formats[0] if formats else raw.get("filter")
+    return MetricsCollectorSpec(kind=kind, path=path, filter=filter_)
+
+
+def experiment_spec_from_dict(data: Mapping[str, Any]) -> ExperimentSpec:
+    """Build an ExperimentSpec from a CR-shaped or flat mapping."""
+    if "spec" in data:  # CR shape
+        name = (data.get("metadata") or {}).get("name")
+        spec = data["spec"]
+    else:
+        name = data.get("name")
+        spec = data
+    if not name:
+        raise SpecError("experiment name missing (metadata.name or name)")
+    if "objective" not in spec:
+        raise SpecError("spec.objective is required")
+
+    algo_raw = spec.get("algorithm") or {}
+    algorithm = AlgorithmSpec(
+        name=algo_raw.get("algorithmName", algo_raw.get("name", "random")),
+        settings=_settings_list(
+            algo_raw.get("algorithmSettings", algo_raw.get("settings"))
+        ),
+    )
+    early_stopping = None
+    es_raw = spec.get("earlyStopping")
+    if es_raw:
+        early_stopping = EarlyStoppingSpec(
+            name=es_raw.get("algorithmName", es_raw.get("name", "medianstop")),
+            settings=_settings_list(
+                es_raw.get("algorithmSettings", es_raw.get("settings"))
+            ),
+        )
+
+    # trialTemplate: only the command argv carries over (the reference's
+    # ${trialParameters.X} placeholders work unchanged); K8s job fields are
+    # meaningless here
+    command = spec.get("command")
+    template = spec.get("trialTemplate") or {}
+    if command is None:
+        command = template.get("command")
+
+    resume = spec.get("resumePolicy", "Never")
+    try:
+        resume_policy = ResumePolicy(resume)
+    except ValueError as e:
+        raise SpecError(f"unknown resumePolicy {resume!r}") from e
+
+    return ExperimentSpec(
+        name=name,
+        objective=_parse_objective(spec["objective"]),
+        algorithm=algorithm,
+        parameters=[_parse_parameter(p) for p in spec.get("parameters") or ()],
+        early_stopping=early_stopping,
+        parallel_trial_count=int(spec.get("parallelTrialCount", 3)),
+        max_trial_count=(
+            int(spec["maxTrialCount"]) if spec.get("maxTrialCount") is not None else None
+        ),
+        max_failed_trial_count=(
+            int(spec["maxFailedTrialCount"])
+            if spec.get("maxFailedTrialCount") is not None
+            else None
+        ),
+        resume_policy=resume_policy,
+        metrics_collector=_parse_collector(spec.get("metricsCollectorSpec")),
+        command=[str(c) for c in command] if command else None,
+    )
+
+
+def load_experiment_yaml(path: str) -> ExperimentSpec:
+    with open(path) as f:
+        data = yaml.safe_load(f)
+    if not isinstance(data, Mapping):
+        raise SpecError(f"{path} must contain a mapping")
+    return experiment_spec_from_dict(data)

@@ -1,0 +1,165 @@
+"""Prometheus-style metrics + profiling hooks.
+
+Parity with the reference controller's Prometheus instrumentation
+(``pkg/controller.v1beta1/experiment/util/prometheus_metrics.go:40-60`` and
+``trial/util/prometheus_metrics.go:40-60``: ``katib_experiment_*_total``,
+``katib_experiments_current``, ``katib_trial_*_total`` incl.
+``katib_trial_metrics_unavailable_total``) without the client_golang
+dependency: a tiny thread-safe registry with text exposition and an optional
+``/metrics`` HTTP endpoint.  The orchestrator increments these; anything
+that scrapes Prometheus text format can consume them.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Iterable
+
+
+class _Metric:
+    def __init__(self, name: str, help_text: str, kind: str):
+        self.name = name
+        self.help = help_text
+        self.kind = kind
+        self._values: dict[tuple[tuple[str, str], ...], float] = {}
+        self._lock = threading.Lock()
+
+    def _key(self, labels: dict[str, str]) -> tuple[tuple[str, str], ...]:
+        return tuple(sorted(labels.items()))
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels: str) -> None:
+        self.inc(-amount, **labels)
+
+    def set(self, value: float, **labels: str) -> None:
+        with self._lock:
+            self._values[self._key(labels)] = value
+
+    def get(self, **labels: str) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+    def samples(self) -> Iterable[tuple[dict[str, str], float]]:
+        with self._lock:
+            return [(dict(k), v) for k, v in self._values.items()]
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_text: str = "") -> _Metric:
+        return self._register(name, help_text, "counter")
+
+    def gauge(self, name: str, help_text: str = "") -> _Metric:
+        return self._register(name, help_text, "gauge")
+
+    def _register(self, name: str, help_text: str, kind: str) -> _Metric:
+        with self._lock:
+            metric = self._metrics.get(name)
+            if metric is None:
+                metric = _Metric(name, help_text, kind)
+                self._metrics[name] = metric
+            return metric
+
+    def render(self) -> str:
+        """Prometheus text exposition format."""
+        lines: list[str] = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            samples = m.samples()
+            if not samples:
+                lines.append(f"{m.name} 0")
+                continue
+            for labels, value in samples:
+                if labels:
+                    label_str = ",".join(
+                        f'{k}="{v}"' for k, v in sorted(labels.items())
+                    )
+                    lines.append(f"{m.name}{{{label_str}}} {value:g}")
+                else:
+                    lines.append(f"{m.name} {value:g}")
+        return "\n".join(lines) + "\n"
+
+    def serve(self, port: int = 0, host: str = "127.0.0.1") -> "MetricsServer":
+        """Expose ``/metrics`` on a daemon thread; returns a stoppable handle
+        (reference serves on ``:8080``, ``config defaults.go:14``)."""
+        registry = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                if self.path not in ("/metrics", "/"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = registry.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence per-request stderr noise
+                pass
+
+        server = ThreadingHTTPServer((host, port), Handler)
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        return MetricsServer(server, thread)
+
+
+class MetricsServer:
+    def __init__(self, server: ThreadingHTTPServer, thread: threading.Thread):
+        self._server = server
+        self._thread = thread
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+# -- default registry + the reference metric set -----------------------------
+
+REGISTRY = MetricsRegistry()
+
+experiments_created = REGISTRY.counter(
+    "katib_experiment_created_total", "Experiments started"
+)
+experiments_succeeded = REGISTRY.counter(
+    "katib_experiment_succeeded_total", "Experiments reaching a success condition"
+)
+experiments_failed = REGISTRY.counter(
+    "katib_experiment_failed_total", "Experiments reaching Failed"
+)
+experiments_current = REGISTRY.gauge(
+    "katib_experiments_current", "Experiments currently running"
+)
+trials_created = REGISTRY.counter("katib_trial_created_total", "Trials launched")
+trials_succeeded = REGISTRY.counter(
+    "katib_trial_succeeded_total", "Trials completing successfully"
+)
+trials_failed = REGISTRY.counter("katib_trial_failed_total", "Trials failing")
+trials_early_stopped = REGISTRY.counter(
+    "katib_trial_early_stopped_total", "Trials stopped by early-stopping rules"
+)
+trials_killed = REGISTRY.counter(
+    "katib_trial_killed_total", "Trials killed by experiment shutdown"
+)
+trials_metrics_unavailable = REGISTRY.counter(
+    "katib_trial_metrics_unavailable_total",
+    "Trials finishing without reporting the objective metric",
+)

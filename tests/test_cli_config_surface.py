@@ -1,0 +1,277 @@
+"""YAML experiment loader, status journal, CLI commands, observability
+registry — the user/ops surface (reference analogs: example experiment CRs,
+UI backend handlers ``backend.go:86-617``, Prometheus metrics)."""
+
+from __future__ import annotations
+
+import json
+import sys
+import urllib.request
+
+import pytest
+
+from katib_tpu.core.types import (
+    Distribution,
+    ExperimentCondition,
+    MetricsCollectorKind,
+    MetricStrategyType,
+    ObjectiveType,
+    ParameterType,
+    ResumePolicy,
+)
+from katib_tpu.sdk.yaml_spec import SpecError, experiment_spec_from_dict, load_experiment_yaml
+
+from helpers import make_spec
+
+
+KATIB_CR = """
+apiVersion: kubeflow.org/v1beta1
+kind: Experiment
+metadata:
+  name: random-example
+spec:
+  objective:
+    type: maximize
+    goal: 0.99
+    objectiveMetricName: Validation-accuracy
+    additionalMetricNames: [Train-accuracy]
+    metricStrategies:
+      - {name: Train-accuracy, value: latest}
+  algorithm:
+    algorithmName: random
+    algorithmSettings:
+      - {name: random_state, value: "42"}
+  parallelTrialCount: 3
+  maxTrialCount: 12
+  maxFailedTrialCount: 3
+  resumePolicy: LongRunning
+  parameters:
+    - name: lr
+      parameterType: double
+      feasibleSpace: {min: "0.01", max: "0.03", distribution: logUniform}
+    - name: num-layers
+      parameterType: int
+      feasibleSpace: {min: "2", max: "5", step: "1"}
+    - name: optimizer
+      parameterType: categorical
+      feasibleSpace: {list: [sgd, adam, ftrl]}
+    - name: momentum
+      parameterType: discrete
+      feasibleSpace: {list: ["0.5", "0.9"]}
+  metricsCollectorSpec:
+    collector: {kind: StdOut}
+  trialTemplate:
+    command:
+      - python
+      - train.py
+      - "--lr=${trialParameters.lr}"
+"""
+
+
+class TestYamlLoader:
+    def test_katib_cr_shape(self, tmp_path):
+        p = tmp_path / "exp.yaml"
+        p.write_text(KATIB_CR)
+        spec = load_experiment_yaml(str(p))
+        assert spec.name == "random-example"
+        assert spec.objective.type is ObjectiveType.MAXIMIZE
+        assert spec.objective.goal == 0.99
+        assert spec.objective.additional_metric_names == ("Train-accuracy",)
+        assert spec.objective.strategy_for("Train-accuracy") is MetricStrategyType.LATEST
+        assert spec.algorithm.name == "random"
+        assert spec.algorithm.settings["random_state"] == "42"
+        assert spec.parallel_trial_count == 3
+        assert spec.max_trial_count == 12
+        assert spec.resume_policy is ResumePolicy.LONG_RUNNING
+        lr = spec.parameter("lr")
+        assert lr.type is ParameterType.DOUBLE
+        assert lr.feasible.distribution is Distribution.LOG_UNIFORM
+        layers = spec.parameter("num-layers")
+        assert layers.type is ParameterType.INT and layers.feasible.step == 1
+        assert spec.parameter("optimizer").feasible.list == ("sgd", "adam", "ftrl")
+        assert spec.parameter("momentum").feasible.list == (0.5, 0.9)
+        assert spec.metrics_collector.kind is MetricsCollectorKind.STDOUT
+        assert spec.command == ["python", "train.py", "--lr=${trialParameters.lr}"]
+
+    def test_flat_shape(self):
+        spec = experiment_spec_from_dict(
+            {
+                "name": "flat",
+                "objective": {"type": "minimize", "objectiveMetricName": "loss"},
+                "algorithm": {"name": "tpe", "settings": {"n_startup": "5"}},
+                "parameters": [
+                    {
+                        "name": "x",
+                        "type": "double",
+                        "feasible": {"min": 0, "max": 1},
+                    }
+                ],
+                "command": ["echo", "${trialParameters.x}"],
+            }
+        )
+        assert spec.algorithm.name == "tpe"
+        assert spec.algorithm.settings == {"n_startup": "5"}
+
+    @pytest.mark.parametrize(
+        "mutation, match",
+        [
+            ({"metadata": {}}, "name missing"),
+            ({"spec": {}}, "objective"),
+        ],
+    )
+    def test_errors(self, mutation, match):
+        base = {"metadata": {"name": "x"}, "spec": {"objective": {"type": "minimize", "objectiveMetricName": "m"}}}
+        base.update(mutation)
+        with pytest.raises(SpecError, match=match):
+            experiment_spec_from_dict(base)
+
+    def test_unknown_distribution(self):
+        with pytest.raises(SpecError, match="distribution"):
+            experiment_spec_from_dict(
+                {
+                    "name": "x",
+                    "objective": {"type": "minimize", "objectiveMetricName": "m"},
+                    "parameters": [
+                        {
+                            "name": "p",
+                            "type": "double",
+                            "feasible": {"min": 0, "max": 1, "distribution": "zipf"},
+                        }
+                    ],
+                }
+            )
+
+
+class TestStatusJournal:
+    def test_status_written_and_listed(self, tmp_path):
+        from katib_tpu.orchestrator.orchestrator import Orchestrator
+        from katib_tpu.orchestrator.status import list_statuses, read_status
+
+        def train(ctx):
+            ctx.report(loss=(ctx.params["x"]) ** 2)
+
+        spec = make_spec("random", train_fn=train, max_trial_count=2,
+                         parallel_trial_count=1)
+        orch = Orchestrator(workdir=str(tmp_path))
+        exp = orch.run(spec)
+        status = read_status(str(tmp_path), exp.name)
+        assert status["condition"] == "MaxTrialsReached"
+        assert status["counts"]["succeeded"] == 2
+        assert status["optimal"]["trial_name"] in status["trials"]
+        trial = status["trials"][status["optimal"]["trial_name"]]
+        assert trial["observation"][0]["name"] == "loss"
+        assert [s["name"] for s in list_statuses(str(tmp_path))] == [exp.name]
+
+
+class TestCli:
+    def test_run_list_describe(self, tmp_path, capsys):
+        from katib_tpu.cli import main
+
+        exp_yaml = tmp_path / "exp.yaml"
+        exp_yaml.write_text(
+            """
+metadata: {name: cli-exp}
+spec:
+  objective: {type: minimize, objectiveMetricName: loss}
+  algorithm: {algorithmName: grid}
+  maxTrialCount: 3
+  parallelTrialCount: 1
+  parameters:
+    - name: x
+      parameterType: int
+      feasibleSpace: {min: "0", max: "2", step: "1"}
+  command: [%s, -c, "print('loss=' + str(float(%s) ** 2))"]
+"""
+            % (json.dumps(sys.executable), '${trialParameters.x}')
+        )
+        workdir = str(tmp_path / "runs")
+        rc = main(["run", str(exp_yaml), "--workdir", workdir])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "cli-exp" in out and "optimal trial" in out
+        # best x is 0 -> loss 0.0
+        assert "x = 0" in out
+
+        rc = main(["list", "--workdir", workdir])
+        out = capsys.readouterr().out
+        assert rc == 0 and "cli-exp" in out and "MaxTrialsReached" in out
+
+        rc = main(["describe", "cli-exp", "--workdir", workdir])
+        out = capsys.readouterr().out
+        assert rc == 0 and "Optimal:" in out and out.count("cli-exp-") >= 3
+
+        rc = main(["describe", "ghost", "--workdir", workdir])
+        assert rc == 1
+
+    def test_run_without_command_errors(self, tmp_path, capsys):
+        from katib_tpu.cli import main
+
+        exp_yaml = tmp_path / "exp.yaml"
+        exp_yaml.write_text(
+            """
+metadata: {name: no-cmd}
+spec:
+  objective: {type: minimize, objectiveMetricName: loss}
+  parameters:
+    - name: x
+      parameterType: double
+      feasibleSpace: {min: "0", max: "1"}
+"""
+        )
+        rc = main(["run", str(exp_yaml), "--workdir", str(tmp_path / "runs")])
+        assert rc == 2
+        assert "no trial command" in capsys.readouterr().err
+
+
+class TestObservability:
+    def test_counters_and_render(self):
+        from katib_tpu.utils.observability import MetricsRegistry
+
+        reg = MetricsRegistry()
+        c = reg.counter("test_total", "help text")
+        g = reg.gauge("test_current")
+        c.inc()
+        c.inc(2, algorithm="tpe")
+        g.set(5)
+        text = reg.render()
+        assert "# HELP test_total help text" in text
+        assert "# TYPE test_total counter" in text
+        assert "test_total 1" in text
+        assert 'test_total{algorithm="tpe"} 2' in text
+        assert "test_current 5" in text
+
+    def test_orchestrator_increments(self, tmp_path):
+        from katib_tpu.orchestrator.orchestrator import Orchestrator
+        from katib_tpu.utils import observability as obs
+
+        created0 = obs.trials_created.get()
+        succ0 = obs.trials_succeeded.get()
+        exp_done0 = obs.experiments_succeeded.get(algorithm="random")
+
+        def train(ctx):
+            ctx.report(loss=1.0)
+
+        spec = make_spec("random", train_fn=train, max_trial_count=2,
+                         parallel_trial_count=1)
+        exp = Orchestrator(workdir=str(tmp_path)).run(spec)
+        assert exp.condition is ExperimentCondition.MAX_TRIALS_REACHED
+        assert obs.trials_created.get() - created0 == 2
+        assert obs.trials_succeeded.get() - succ0 == 2
+        assert obs.experiments_succeeded.get(algorithm="random") - exp_done0 == 1
+        assert obs.experiments_current.get() == 0
+
+    def test_http_exposition(self):
+        from katib_tpu.utils.observability import REGISTRY
+
+        server = REGISTRY.serve(port=0)
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/metrics", timeout=5
+            ).read().decode()
+            assert "katib_experiment_created_total" in body
+            with pytest.raises(Exception):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/nope", timeout=5
+                )
+        finally:
+            server.stop()

@@ -1,0 +1,189 @@
+"""TFEvent collector: tfrecord framing, protobuf decoding, writer round-trip,
+black-box trial integration — parity coverage for the reference tfevent
+metrics collector (``test/unit/v1beta1/metricscollector``), with synthesized
+event files instead of a TF trainer run."""
+
+from __future__ import annotations
+
+import struct
+import sys
+
+from katib_tpu.core.types import (
+    MetricsCollectorKind,
+    MetricsCollectorSpec,
+    ObjectiveSpec,
+    ObjectiveType,
+    Trial,
+    TrialCondition,
+    TrialSpec,
+)
+from katib_tpu.runner.tfevent import (
+    TFEventWriter,
+    _field,
+    _masked_crc,
+    _varint,
+    crc32c,
+    parse_tfevent_dir,
+    parse_tfevent_file,
+)
+from katib_tpu.runner.trial_runner import run_trial
+from katib_tpu.store.base import MemoryObservationStore
+
+
+class TestCrc32c:
+    def test_known_vector(self):
+        # RFC 3720 B.4 test vector
+        assert crc32c(b"123456789") == 0xE3069283
+
+    def test_empty(self):
+        assert crc32c(b"") == 0
+
+
+class TestRoundTrip:
+    def test_writer_reader(self, tmp_path):
+        w = TFEventWriter(str(tmp_path))
+        w.add_scalar("accuracy", 0.5, step=1, wall_time=100.0)
+        w.add_scalar("accuracy", 0.75, step=2, wall_time=101.0)
+        w.add_scalar("loss", 1.5, step=1, wall_time=100.0)
+        w.close()
+        logs = parse_tfevent_file(w.path)
+        assert [(l.metric_name, l.step) for l in logs] == [
+            ("accuracy", 1), ("accuracy", 2), ("loss", 1),
+        ]
+        assert abs(logs[1].value - 0.75) < 1e-6
+        assert logs[0].timestamp == 100.0
+
+    def test_metric_filter(self, tmp_path):
+        w = TFEventWriter(str(tmp_path))
+        w.add_scalar("keep", 1.0, step=0, wall_time=1.0)
+        w.add_scalar("drop", 2.0, step=0, wall_time=1.0)
+        w.close()
+        logs = parse_tfevent_file(w.path, ["keep"])
+        assert [l.metric_name for l in logs] == ["keep"]
+
+    def test_dir_scan_merges_sorted(self, tmp_path):
+        (tmp_path / "sub").mkdir()
+        w1 = TFEventWriter(str(tmp_path))
+        w1.add_scalar("m", 1.0, step=2, wall_time=200.0)
+        w1.close()
+        w2 = TFEventWriter(str(tmp_path / "sub"))
+        w2.add_scalar("m", 0.5, step=1, wall_time=100.0)
+        w2.close()
+        logs = parse_tfevent_dir(str(tmp_path))
+        assert [l.value for l in logs] == [0.5, 1.0]  # wall-time order
+        assert parse_tfevent_dir(str(tmp_path / "nothing")) == []
+
+    def test_truncated_tail_is_ignored(self, tmp_path):
+        w = TFEventWriter(str(tmp_path))
+        w.add_scalar("m", 1.0, step=0, wall_time=1.0)
+        w.flush()
+        # simulate a live trial mid-write: garbage half-frame at the tail
+        with open(w.path, "ab") as f:
+            f.write(struct.pack("<Q", 10_000) + b"\x00\x01\x02")
+        logs = parse_tfevent_file(w.path)
+        assert len(logs) == 1
+        w.close()
+
+    def test_corrupt_crc_stops_cleanly(self, tmp_path):
+        w = TFEventWriter(str(tmp_path))
+        w.add_scalar("m", 1.0, step=0, wall_time=1.0)
+        w.add_scalar("m", 2.0, step=1, wall_time=2.0)
+        w.close()
+        raw = bytearray(open(w.path, "rb").read())
+        raw[14] ^= 0xFF  # flip a payload byte in the first record
+        open(w.path, "wb").write(bytes(raw))
+        assert parse_tfevent_file(w.path) == []
+
+
+class TestTF2TensorEncoding:
+    def test_tensor_scalar_summary(self, tmp_path):
+        # hand-build an Event whose Summary.Value carries a TensorProto
+        # (dtype=DT_FLOAT, float_val=[0.625]) instead of simple_value — the
+        # TF2 tf.summary.scalar encoding
+        tensor = _field(1, 0) + _varint(1) + _field(5, 2) + _varint(4) + struct.pack("<f", 0.625)
+        tag = b"acc"
+        value = (
+            _field(1, 2) + _varint(len(tag)) + tag
+            + _field(8, 2) + _varint(len(tensor)) + tensor
+        )
+        summary = _field(1, 2) + _varint(len(value)) + value
+        event = (
+            _field(1, 1) + struct.pack("<d", 5.0)
+            + _field(2, 0) + _varint(7)
+            + _field(5, 2) + _varint(len(summary)) + summary
+        )
+        path = tmp_path / "events.out.tfevents.123.host"
+        with open(path, "wb") as f:
+            header = struct.pack("<Q", len(event))
+            f.write(header + struct.pack("<I", _masked_crc(header)))
+            f.write(event + struct.pack("<I", _masked_crc(event)))
+        logs = parse_tfevent_file(str(path))
+        assert [(l.metric_name, l.value, l.step) for l in logs] == [("acc", 0.625, 7)]
+
+
+class TestBlackboxIntegration:
+    def test_tfevent_collector_trial(self, tmp_path):
+        """Black-box trial writes event files; collector parses them after
+        exit (reference ``tfevent-metricscollector/main.py:47-79`` flow)."""
+        logdir = tmp_path / "logs"
+        script = tmp_path / "train.py"
+        script.write_text(
+            "import sys\n"
+            "sys.path.insert(0, %r)\n"
+            "from katib_tpu.runner.tfevent import TFEventWriter\n"
+            "w = TFEventWriter(%r)\n"
+            "w.add_scalar('val_acc', 0.875, step=1, wall_time=1.0)\n"
+            "w.close()\n"
+            "print('val_acc=0.111')  # stdout must NOT be scraped for TFEvent kind\n"
+            % (str(__import__('pathlib').Path(__file__).resolve().parents[1]), str(logdir))
+        )
+        store = MemoryObservationStore()
+        obj = ObjectiveSpec(type=ObjectiveType.MAXIMIZE, objective_metric_name="val_acc")
+        trial = Trial(
+            name="tfe",
+            experiment_name="e",
+            spec=TrialSpec(
+                command=[sys.executable, str(script)],
+                metrics_collector=MetricsCollectorSpec(
+                    kind=MetricsCollectorKind.TFEVENT, path=str(logdir)
+                ),
+            ),
+        )
+        result = run_trial(trial, store, obj)
+        assert result.condition is TrialCondition.SUCCEEDED
+        logs = store.get("tfe", "val_acc")
+        assert [l.value for l in logs] == [0.875]
+
+
+class TestTfeventValidation:
+    def test_tfevent_requires_path(self):
+        import pytest as _pytest
+
+        from katib_tpu.core.validation import ValidationError, validate_experiment
+
+        from helpers import make_spec
+
+        spec = make_spec("random")
+        spec.train_fn = None
+        spec.command = ["echo", "x"]
+        spec.metrics_collector = MetricsCollectorSpec(kind=MetricsCollectorKind.TFEVENT)
+        with _pytest.raises(ValidationError, match="requires a path"):
+            validate_experiment(spec)
+
+    def test_tfevent_rejects_early_stopping(self):
+        import pytest as _pytest
+
+        from katib_tpu.core.types import EarlyStoppingSpec
+        from katib_tpu.core.validation import ValidationError, validate_experiment
+
+        from helpers import make_spec
+
+        spec = make_spec("random")
+        spec.train_fn = None
+        spec.command = ["echo", "x"]
+        spec.metrics_collector = MetricsCollectorSpec(
+            kind=MetricsCollectorKind.TFEVENT, path="/tmp/events"
+        )
+        spec.early_stopping = EarlyStoppingSpec(name="medianstop")
+        with _pytest.raises(ValidationError, match="early stopping"):
+            validate_experiment(spec)
