@@ -8,7 +8,7 @@ observation store:
 - ``run <experiment.yaml>``   create + run a (black-box) experiment to completion
 - ``list``                    experiments in the workdir with live counts
 - ``describe <experiment>``   trials, assignments, observations, optimal
-- ``metrics <experiment> <trial>``  raw metric log for one trial
+- ``metrics <trial>``               raw metric log for one trial
 - ``doctor``                  environment report (devices, native runtime)
 """
 
@@ -201,7 +201,6 @@ def main(argv: list[str] | None = None) -> int:
     p.set_defaults(fn=cmd_describe)
 
     p = sub.add_parser("metrics", help="dump a trial's metric log")
-    p.add_argument("experiment")
     p.add_argument("trial")
     p.set_defaults(fn=cmd_metrics)
 

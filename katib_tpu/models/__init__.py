@@ -1,2 +1,9 @@
 from katib_tpu.models.data import Dataset, load_cifar10, load_mnist  # noqa: F401
 from katib_tpu.models.mnist import MLP, SmallCNN, mnist_trial, train_classifier  # noqa: F401
+from katib_tpu.models.transformer import (  # noqa: F401
+    TransformerLM,
+    make_attention_fn,
+    markov_dataset,
+    train_lm,
+    transformer_trial,
+)

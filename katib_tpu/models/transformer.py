@@ -1,0 +1,270 @@
+"""Tunable long-context transformer LM — the sequence-parallel trial workload.
+
+The reference's trial zoo stops at small CNNs (SURVEY.md §2.3); it has no
+long-context model family because it has no sequence parallelism.  This
+module adds a decoder-only transformer whose attention runs through the
+fused flash kernel (``katib_tpu.ops.flash_attention``) on one chip and
+through ring / all-to-all sequence parallelism
+(``katib_tpu.parallel.ring_attention``) when the trial's mesh has a ``seq``
+axis — so HP search (lr, width, depth, heads) can drive long-sequence
+training on a sharded mesh with the same trial API as the CNN workloads.
+
+Tunable parameters understood by ``transformer_trial``: lr, d_model,
+n_heads, n_layers, seq_len, batch_size, steps, warmup_frac,
+attn(ring|ulysses), dropout.
+
+The training task is a synthetic first-order Markov language-modelling
+problem: next-token structure is learnable (entropy well below uniform) and
+the data is generated on the fly, so trials are hermetic — no dataset
+download, the objective (validation loss) still orders hyperparameters
+meaningfully.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from katib_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS, shard_batch
+from katib_tpu.parallel.ring_attention import make_sequence_parallel_attention
+from katib_tpu.parallel.train import TrainState, clip_by_global_norm
+
+
+class Block(nn.Module):
+    d_model: int
+    n_heads: int
+    attn_fn: Callable  # (q, k, v) [B,H,S,D] -> [B,H,S,D]
+    dropout: float = 0.0
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        d_head = self.d_model // self.n_heads
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        qkv = nn.Dense(3 * self.d_model, use_bias=False, dtype=self.dtype)(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):  # [B, S, D_model] -> [B, H, S, d_head]
+            b, s, _ = t.shape
+            return t.reshape(b, s, self.n_heads, d_head).transpose(0, 2, 1, 3)
+
+        o = self.attn_fn(heads(q), heads(k), heads(v))
+        b, nh, s, dh = o.shape
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, nh * dh).astype(self.dtype)
+        o = nn.Dense(self.d_model, use_bias=False, dtype=self.dtype)(o)
+        x = x + nn.Dropout(self.dropout, deterministic=deterministic)(o)
+
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.Dense(4 * self.d_model, dtype=self.dtype)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(self.d_model, dtype=self.dtype)(h)
+        return x + nn.Dropout(self.dropout, deterministic=deterministic)(h)
+
+
+class TransformerLM(nn.Module):
+    vocab_size: int
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    max_seq_len: int = 2048
+    dropout: float = 0.0
+    dtype: jnp.dtype = jnp.bfloat16
+    attn_fn: Callable | None = None  # default set in setup-free __call__
+
+    @nn.compact
+    def __call__(self, tokens, deterministic: bool = True):
+        attn = self.attn_fn
+        if attn is None:
+            attn = _dense_causal_attention
+        x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype)(tokens)
+        pos = nn.Embed(self.max_seq_len, self.d_model, dtype=self.dtype)(
+            jnp.arange(tokens.shape[1])[None, :]
+        )
+        x = x + pos
+        for _ in range(self.n_layers):
+            x = Block(
+                d_model=self.d_model, n_heads=self.n_heads, attn_fn=attn,
+                dropout=self.dropout, dtype=self.dtype,
+            )(x, deterministic)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        return nn.Dense(self.vocab_size, dtype=jnp.float32)(x)
+
+
+def _dense_causal_attention(q, k, v):
+    from katib_tpu.ops.flash_attention import reference_attention
+
+    return reference_attention(q, k, v, causal=True)
+
+
+def make_attention_fn(mesh=None, strategy: str = "ring"):
+    """Attention for a trial's mesh: sequence-parallel when the mesh has a
+    ``seq`` axis > 1, single-device flash/dense otherwise."""
+    if mesh is None:
+        from katib_tpu.ops.flash_attention import flash_attention
+
+        if jax.default_backend() == "tpu":
+            return lambda q, k, v: flash_attention(q, k, v, causal=True)
+        return _dense_causal_attention
+    return make_sequence_parallel_attention(mesh, strategy=strategy, causal=True)
+
+
+# ---------------------------------------------------------------------------
+# synthetic Markov LM data
+# ---------------------------------------------------------------------------
+
+
+def markov_dataset(
+    vocab_size: int, n_seq: int, seq_len: int, *, seed: int = 0, branching: int = 4
+) -> np.ndarray:
+    """Token sequences from a fixed sparse first-order Markov chain: every
+    token has ``branching`` likely successors, so the optimal next-token loss
+    is ≈ log(branching) — far below log(vocab) for an untrained model."""
+    rng = np.random.default_rng(seed)
+    succ = rng.integers(0, vocab_size, size=(vocab_size, branching))
+    out = np.empty((n_seq, seq_len), np.int32)
+    state = rng.integers(0, vocab_size, size=n_seq)
+    for t in range(seq_len):
+        out[:, t] = state
+        pick = rng.integers(0, branching, size=n_seq)
+        state = succ[state, pick]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# training loop
+# ---------------------------------------------------------------------------
+
+
+def lm_loss(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Next-token cross entropy over [B, S, V] logits / [B, S] tokens."""
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    tgt = tokens[:, 1:]
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+def train_lm(
+    model: TransformerLM,
+    data: np.ndarray,
+    *,
+    lr: float,
+    steps: int,
+    batch_size: int,
+    warmup_frac: float = 0.1,
+    grad_clip: float = 1.0,
+    mesh=None,
+    seed: int = 0,
+    report=None,
+    report_every: int = 10,
+) -> float:
+    """Train on ``data`` [N, S]; returns final eval loss on a held-out tail.
+    Calls ``report(step, loss, eval_loss)`` every ``report_every`` steps."""
+    rng = np.random.default_rng(seed)
+    n_eval = max(batch_size, len(data) // 10)
+    train, heldout = data[:-n_eval], data[-n_eval:]
+
+    # init batch must divide the mesh's data axis (the attention shard_map
+    # shards the batch dimension even while tracing init)
+    init_batch = 1
+    if mesh is not None and DATA_AXIS in mesh.shape:
+        init_batch = mesh.shape[DATA_AXIS]
+    params = model.init(
+        jax.random.PRNGKey(seed), jnp.zeros((init_batch, data.shape[1]), jnp.int32)
+    )
+    sched = optax.warmup_cosine_decay_schedule(
+        0.0, lr, max(1, int(steps * warmup_frac)), steps
+    )
+    tx = optax.adamw(sched, weight_decay=0.01)
+
+    use_dropout = model.dropout > 0.0
+
+    def loss_fn(params, tokens, dropout_key):
+        if use_dropout:
+            logits = model.apply(
+                params, tokens, deterministic=False, rngs={"dropout": dropout_key}
+            )
+        else:
+            logits = model.apply(params, tokens)
+        return lm_loss(logits, tokens)
+
+    @jax.jit
+    def step_fn(state: TrainState, tokens, dropout_key):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, tokens, dropout_key)
+        grads, _ = clip_by_global_norm(grads, grad_clip)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(state.step + 1, params, opt_state), loss
+
+    @jax.jit
+    def eval_fn(params, tokens):
+        return lm_loss(model.apply(params, tokens), tokens)
+
+    state = TrainState.create(params, tx)
+    if mesh is not None:
+        from katib_tpu.parallel.mesh import replicate
+
+        state = replicate(state, mesh)
+
+    def place(tokens):
+        tokens = jnp.asarray(tokens)
+        return tokens if mesh is None else shard_batch(tokens, mesh)
+
+    eval_tokens = place(heldout[:batch_size])
+    eval_loss: float | None = None
+    dkey = jax.random.PRNGKey(seed + 1)
+    for s in range(steps):
+        idx = rng.integers(0, len(train), size=batch_size)
+        dkey, sub = jax.random.split(dkey)
+        state, loss = step_fn(state, place(train[idx]), sub)
+        eval_loss = None  # stale after this step's update
+        if report is not None and (s % report_every == 0 or s == steps - 1):
+            eval_loss = float(eval_fn(state.params, eval_tokens))
+            if report(step=s, loss=float(loss), eval_loss=eval_loss) is False:
+                break
+    if eval_loss is None:
+        eval_loss = float(eval_fn(state.params, eval_tokens))
+    return eval_loss
+
+
+# -- the white-box trial function -------------------------------------------
+
+
+def transformer_trial(ctx) -> None:
+    """White-box trial: tunable long-context LM reporting train/eval loss."""
+    p = ctx.params
+    vocab = int(p.get("vocab_size", 256))
+    seq_len = int(p.get("seq_len", 512))
+    mesh = ctx.mesh
+    strategy = str(p.get("attn", "ring"))
+
+    model = TransformerLM(
+        vocab_size=vocab,
+        d_model=int(p.get("d_model", 128)),
+        n_heads=int(p.get("n_heads", 4)),
+        n_layers=int(p.get("n_layers", 2)),
+        max_seq_len=seq_len,
+        dropout=float(p.get("dropout", 0.0)),
+        attn_fn=make_attention_fn(mesh, strategy=strategy),
+    )
+    data = markov_dataset(
+        vocab, int(p.get("n_seq", 512)), seq_len, seed=int(p.get("data_seed", 0))
+    )
+
+    def report(step, loss, eval_loss):
+        return ctx.report(step=step, loss=loss, eval_loss=eval_loss)
+
+    train_lm(
+        model,
+        data,
+        lr=float(p.get("lr", 3e-3)),
+        steps=int(p.get("steps", 60)),
+        batch_size=int(p.get("batch_size", 16)),
+        warmup_frac=float(p.get("warmup_frac", 0.1)),
+        mesh=mesh,
+        report=report,
+    )

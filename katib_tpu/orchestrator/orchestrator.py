@@ -129,7 +129,18 @@ class Orchestrator:
         if self._stop_requested.is_set():
             stop_event.set()
 
-        mesh = self._resolve_mesh(spec)
+        # a bad mesh config must still settle the experiments_current gauge
+        # and the status journal before surfacing
+        try:
+            mesh = self._resolve_mesh(spec)
+        except Exception:
+            exp.condition = ExperimentCondition.FAILED
+            exp.message = "mesh config error:\n" + traceback.format_exc(limit=5)
+            exp.completion_time = time.time()
+            exp.update_optimal()
+            self._finish(exp)
+            raise
+
         with cf.ThreadPoolExecutor(
             max_workers=spec.parallel_trial_count, thread_name_prefix=f"trial-{exp.name}"
         ) as pool:

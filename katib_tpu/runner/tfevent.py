@@ -30,17 +30,23 @@ from katib_tpu.core.types import MetricLog
 
 # -- crc32c (Castagnoli), table-driven --------------------------------------
 
-_CRC_TABLE: list[int] = []
+_CRC_TABLE: tuple[int, ...] | None = None
 
 
-def _crc_table() -> list[int]:
-    if not _CRC_TABLE:
+def _crc_table() -> tuple[int, ...]:
+    # built as a local and published in one assignment: concurrent trial
+    # threads either see None (and rebuild identically) or the full table —
+    # never a partially filled one
+    global _CRC_TABLE
+    if _CRC_TABLE is None:
         poly = 0x82F63B78
+        table = []
         for i in range(256):
             crc = i
             for _ in range(8):
                 crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
-            _CRC_TABLE.append(crc)
+            table.append(crc)
+        _CRC_TABLE = tuple(table)
     return _CRC_TABLE
 
 
@@ -110,7 +116,7 @@ def _tensor_scalar(buf: bytes) -> float | None:
         elif field == 4 and wire == 2:  # tensor_content
             content = value
         elif field == 5:  # float_val (packed or single fixed32)
-            raw = value if wire == 2 else value
+            raw = value
             if isinstance(raw, bytes) and len(raw) >= 4:
                 float_val = struct.unpack("<f", raw[:4])[0]
         elif field == 6:  # double_val
