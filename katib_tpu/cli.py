@@ -8,7 +8,8 @@ observation store:
 - ``run <experiment.yaml>``   create + run a (black-box) experiment to completion
 - ``list``                    experiments in the workdir with live counts
 - ``describe <experiment>``   trials, assignments, observations, optimal
-- ``metrics <trial>``               raw metric log for one trial
+- ``metrics <trial>``         raw metric log for one trial
+- ``ui``                      serve the REST API + HTML dashboard
 - ``doctor``                  environment report (devices, native runtime)
 """
 
@@ -157,6 +158,23 @@ def cmd_metrics(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_ui(args: argparse.Namespace) -> int:
+    from katib_tpu.ui import start_ui
+
+    cfg = KatibConfig.load(args.config)
+    store = cfg.store.make_store()
+    ui = start_ui(args.workdir, store, port=args.port, host=args.host)
+    print(f"katib-tpu dashboard: http://{args.host}:{ui.port}/")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        ui.stop()
+    return 0
+
+
 def cmd_doctor(args: argparse.Namespace) -> int:
     import jax
 
@@ -203,6 +221,12 @@ def main(argv: list[str] | None = None) -> int:
     p = sub.add_parser("metrics", help="dump a trial's metric log")
     p.add_argument("trial")
     p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser("ui", help="serve the REST API + dashboard")
+    p.add_argument("--workdir", default="katib_runs")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8080)
+    p.set_defaults(fn=cmd_ui)
 
     p = sub.add_parser("doctor", help="environment report")
     p.set_defaults(fn=cmd_doctor)

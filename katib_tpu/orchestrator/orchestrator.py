@@ -59,10 +59,15 @@ class Orchestrator:
         mesh=None,
         poll_interval: float = 0.02,
         config=None,
+        slice_allocator=None,
     ):
         self.store = store if store is not None else MemoryObservationStore()
         self.workdir = workdir
         self.mesh = mesh
+        # SliceAllocator (parallel/distributed.py): concurrent trials lease
+        # disjoint sub-meshes of the machine instead of sharing one mesh —
+        # the chip-level analog of parallelTrialCount pod scheduling
+        self.slice_allocator = slice_allocator
         self.poll_interval = poll_interval
         # KatibConfig (core/config.py): runtime registry of per-algorithm
         # defaults + profiler flags, merged into specs at run() time — the
@@ -270,6 +275,15 @@ class Orchestrator:
 
     def _execute(self, exp: Experiment, trial: Trial, mesh):
         # invariant: never raises — _harvest calls f.result() bare
+        if self.slice_allocator is not None and mesh is None:
+            try:
+                with self.slice_allocator.slice_mesh() as trial_mesh:
+                    return self._execute_on(exp, trial, trial_mesh)
+            except Exception:
+                return TrialResult(TrialCondition.FAILED, traceback.format_exc(limit=20))
+        return self._execute_on(exp, trial, mesh)
+
+    def _execute_on(self, exp: Experiment, trial: Trial, mesh):
         want_profile = self.config is not None and self.config.init.enable_profiler
         if want_profile and self._profile_lock.acquire(blocking=False):
             try:

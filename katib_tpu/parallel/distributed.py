@@ -1,0 +1,182 @@
+"""Multi-host initialization and TPU-slice scheduling for trials.
+
+The reference's answer to distributed trials is to delegate to external K8s
+operators (TFJob/PyTorchJob/MPIJob) and merely watch their status via GJSON
+conditions (SURVEY.md §2.4, ``job_util.go:59``); its answer to trial
+parallelism is ``parallelTrialCount`` pods.  TPU-native, both collapse into
+this module:
+
+- ``initialize_distributed`` brings up ``jax.distributed`` for one *slice
+  process group* (coordinator + N hosts).  Inside the slice, collectives
+  ride ICI; across slices, DCN — the sharding annotations ARE the
+  communication backend, there is no NCCL/MPI equivalent to manage.
+- ``SliceAllocator`` partitions the visible devices into fixed-size slice
+  shares and leases one per trial, so ``parallelTrialCount`` concurrent
+  trials each get a disjoint sub-mesh (the analog of the experiment
+  controller's trial budget, ``experiment_controller.go:274-330``, with
+  chips instead of pods as the scheduling unit).
+
+Environment detection covers the standard TPU pod variables
+(``COORDINATOR_ADDRESS``/``NUM_PROCESSES``/``PROCESS_ID``) and falls back
+to single-process — so the same trial code runs on a laptop CPU, one v5e
+chip, or a multi-host slice without changes.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Mapping, Sequence
+
+from katib_tpu.parallel.mesh import DATA_AXIS, make_mesh
+
+_INIT_LOCK = threading.Lock()
+_INITIALIZED = False
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    local_device_ids: Sequence[int] | None = None,
+) -> bool:
+    """Idempotently bring up the JAX process group for this slice.
+
+    Explicit args win; otherwise ``COORDINATOR_ADDRESS`` / ``NUM_PROCESSES``
+    / ``PROCESS_ID`` env vars; otherwise (single-process, the common case on
+    one chip or CPU) this is a no-op.  Returns True when a multi-process
+    group was (or already is) initialized.
+    """
+    global _INITIALIZED
+    with _INIT_LOCK:
+        if _INITIALIZED:
+            return True
+        coordinator_address = coordinator_address or os.environ.get(
+            "COORDINATOR_ADDRESS"
+        )
+        if num_processes is None and "NUM_PROCESSES" in os.environ:
+            num_processes = int(os.environ["NUM_PROCESSES"])
+        if process_id is None and "PROCESS_ID" in os.environ:
+            process_id = int(os.environ["PROCESS_ID"])
+        if coordinator_address is None or not num_processes or num_processes <= 1:
+            return False
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids,
+        )
+        _INITIALIZED = True
+        return True
+
+
+# -- topology presets --------------------------------------------------------
+
+#: chips per named TPU slice topology (v5e sizes from the BASELINE targets)
+SLICE_TOPOLOGIES: dict[str, int] = {
+    "v5e-1": 1,
+    "v5e-4": 4,
+    "v5e-8": 8,
+    "v5e-16": 16,
+    "v5e-32": 32,
+    "v5e-64": 64,
+    "v5e-128": 128,
+    "v5e-256": 256,
+}
+
+
+def topology_size(topology: str) -> int:
+    if topology not in SLICE_TOPOLOGIES:
+        raise ValueError(
+            f"unknown topology {topology!r}; known: {sorted(SLICE_TOPOLOGIES)}"
+        )
+    return SLICE_TOPOLOGIES[topology]
+
+
+# -- per-trial slice leasing -------------------------------------------------
+
+
+@dataclass
+class SliceLease:
+    """A leased share of the machine: build the trial's mesh from it."""
+
+    index: int
+    devices: tuple
+    axes: Mapping[str, int]
+
+    def mesh(self):
+        return make_mesh(dict(self.axes), devices=self.devices)
+
+
+class SliceAllocator:
+    """Partition devices into equal slice shares; lease one per trial.
+
+    ``axes`` is the per-trial mesh template (one axis may be -1 to absorb
+    the share size), e.g. ``{"data": -1}`` or ``{"data": 2, "model": 2}``.
+    ``lease()`` blocks until a share frees up — the orchestrator's thread
+    pool naturally sizes the number of outstanding leases to
+    ``parallel_trial_count``.
+    """
+
+    def __init__(
+        self,
+        slice_size: int,
+        *,
+        devices: Sequence[Any] | None = None,
+        axes: Mapping[str, int] | None = None,
+    ):
+        if devices is None:
+            import jax
+
+            devices = jax.devices()
+        if slice_size <= 0:
+            raise ValueError("slice_size must be positive")
+        if len(devices) < slice_size:
+            raise ValueError(
+                f"need at least {slice_size} devices, have {len(devices)}"
+            )
+        self.slice_size = slice_size
+        self.axes = dict(axes) if axes else {DATA_AXIS: -1}
+        n_slices = len(devices) // slice_size
+        self._free: list[SliceLease] = [
+            SliceLease(
+                index=i,
+                devices=tuple(devices[i * slice_size : (i + 1) * slice_size]),
+                axes=self.axes,
+            )
+            for i in range(n_slices)
+        ]
+        self._cond = threading.Condition()
+        self.n_slices = n_slices
+
+    def available(self) -> int:
+        with self._cond:
+            return len(self._free)
+
+    def lease(self, timeout: float | None = None) -> SliceLease:
+        with self._cond:
+            if not self._cond.wait_for(lambda: self._free, timeout=timeout):
+                raise TimeoutError(
+                    f"no free slice within {timeout}s ({self.n_slices} total)"
+                )
+            return self._free.pop()
+
+    def release(self, lease: SliceLease) -> None:
+        with self._cond:
+            if any(l.index == lease.index for l in self._free):
+                raise ValueError(f"slice {lease.index} is not leased")
+            self._free.append(lease)
+            self._cond.notify()
+
+    @contextmanager
+    def slice_mesh(self, timeout: float | None = None):
+        """``with allocator.slice_mesh() as mesh:`` — lease, build, release."""
+        lease = self.lease(timeout)
+        try:
+            yield lease.mesh()
+        finally:
+            self.release(lease)

@@ -52,6 +52,7 @@ class TrialContext:
         self.labels = dict(labels or {})
         self._stop_event = stop_event
         self._step = 0
+        self._checkpointer = None
 
     # -- metrics -----------------------------------------------------------
 
@@ -100,3 +101,25 @@ class TrialContext:
             raise RuntimeError("trial has no checkpoint directory configured")
         os.makedirs(self.checkpoint_dir, exist_ok=True)
         return self.checkpoint_dir
+
+    def checkpointer(self, max_to_keep: int = 3):
+        """Orbax-backed pytree checkpointer on this trial's directory (PBT
+        lineage arrives pre-populated: the suggester copies the parent's
+        tree here before the trial starts)."""
+        if self._checkpointer is None:
+            from katib_tpu.utils.checkpoint import TrialCheckpointer
+
+            self._checkpointer = TrialCheckpointer(
+                self.ensure_checkpoint_dir(), max_to_keep=max_to_keep
+            )
+        return self._checkpointer
+
+    def save_checkpoint(self, pytree, step: int) -> str:
+        return self.checkpointer().save(pytree, step)
+
+    def restore_checkpoint(self, template=None, step: int | None = None):
+        """Latest (or given-step) checkpoint as ``(pytree, step)``; ``None``
+        on a cold start."""
+        if self.checkpoint_dir is None or not os.path.isdir(self.checkpoint_dir):
+            return None
+        return self.checkpointer().restore(template, step)

@@ -1,0 +1,1 @@
+from katib_tpu.ui.backend import UiServer, start_ui  # noqa: F401

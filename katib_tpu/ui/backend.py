@@ -1,0 +1,309 @@
+"""REST backend + embedded dashboard — parity with the reference UI.
+
+The reference serves an Angular SPA from a Go REST backend that proxies CRD
+CRUD, trial logs, DB-manager metric fetches, and a NAS graph view
+(``pkg/ui/v1beta1/backend.go:86,138,181,463,514,566,617``, ``nas.go``).
+TPU-native there is no API server to proxy: the orchestrator journals
+status to ``<workdir>/<experiment>/status.json`` and metrics live in the
+observation store, so the backend is a thin read-only HTTP layer over those
+two sources plus a single-file HTML dashboard (no build step, no Node).
+
+Endpoints (JSON unless noted):
+
+- ``GET /api/experiments``                     summaries for every journaled experiment
+- ``GET /api/experiment/<name>``               full status incl. trials
+- ``GET /api/experiment/<name>/trials``        trials table rows
+- ``GET /api/trial/<name>/metrics``            raw metric log from the store
+- ``GET /api/experiment/<name>/nas``           NAS graph (nodes/edges) for the
+                                               best (or named ``?trial=``) trial
+- ``GET /``                                    dashboard (text/html)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from katib_tpu.orchestrator.status import list_statuses, read_status
+from katib_tpu.store.base import ObservationStore
+
+
+def _experiment_summary(status: dict) -> dict:
+    return {
+        "name": status.get("name"),
+        "condition": status.get("condition"),
+        "algorithm": status.get("algorithm"),
+        "objective_metric": status.get("objective_metric"),
+        "counts": status.get("counts", {}),
+        "optimal": status.get("optimal"),
+        "start_time": status.get("start_time"),
+        "completion_time": status.get("completion_time"),
+    }
+
+
+def _trial_rows(status: dict) -> list[dict]:
+    rows = []
+    for name, t in (status.get("trials") or {}).items():
+        obs = t.get("observation") or []
+        rows.append(
+            {
+                "name": name,
+                "condition": t.get("condition"),
+                "assignments": t.get("assignments", {}),
+                "labels": t.get("labels", {}),
+                "metrics": {m["name"]: m["latest"] for m in obs},
+                "start_time": t.get("start_time"),
+                "completion_time": t.get("completion_time"),
+            }
+        )
+    return rows
+
+
+# -- NAS graph extraction ----------------------------------------------------
+
+
+def _darts_graph(genotype: dict) -> dict:
+    """Genotype → node/edge list, the shape the reference's UI renders
+    (``nas.go``).  ``normal``/``reduce`` are per-node lists of kept
+    ``[op, src_edge]`` pairs (nas/darts/model.py extract_genotype); source
+    0/1 are the two cell inputs, source j+2 is intermediate node j."""
+    nodes = [{"id": "c_{k-2}", "label": "input-2"}, {"id": "c_{k-1}", "label": "input-1"}]
+    edges = []
+    for cell in ("normal", "reduce"):
+        per_node = genotype.get(cell) or []
+        for i in range(len(per_node)):
+            nodes.append({"id": f"{cell}-{i}", "label": f"{cell} node {i}"})
+        for dst, pairs in enumerate(per_node):
+            for op, src in pairs:
+                src = int(src)
+                src_id = ("c_{k-2}", "c_{k-1}")[src] if src < 2 else f"{cell}-{src - 2}"
+                edges.append({"from": src_id, "to": f"{cell}-{dst}", "op": op})
+    return {"type": "darts", "nodes": nodes, "edges": edges}
+
+
+def _enas_graph(architecture: list) -> dict:
+    """ENAS arc (per layer ``[op_id, skip...]``) → chain with skip edges."""
+    nodes = [{"id": "input", "label": "input"}]
+    edges = []
+    for i, layer in enumerate(architecture):
+        op = layer[0] if layer else 0
+        nodes.append({"id": f"layer-{i}", "label": f"layer {i} (op {op})"})
+        prev = "input" if i == 0 else f"layer-{i - 1}"
+        edges.append({"from": prev, "to": f"layer-{i}", "op": "seq"})
+        for j, bit in enumerate(layer[1:]):
+            if int(bit):
+                src = "input" if j == 0 else f"layer-{j - 1}"
+                edges.append({"from": src, "to": f"layer-{i}", "op": "skip"})
+    nodes.append({"id": "output", "label": "output"})
+    if architecture:
+        edges.append({"from": f"layer-{len(architecture) - 1}", "to": "output", "op": "seq"})
+    return {"type": "enas", "nodes": nodes, "edges": edges}
+
+
+def nas_graph_for_trial(trial: dict) -> dict | None:
+    """Recover the architecture a trial trained: DARTS trials leave
+    ``genotype.json`` in their checkpoint dir (nas/darts/search.py), ENAS
+    trials carry it in the ``architecture`` assignment (enas/service.py)."""
+    arch = (trial.get("assignments") or {}).get("architecture")
+    if arch:
+        try:
+            return _enas_graph(json.loads(arch) if isinstance(arch, str) else arch)
+        except (ValueError, TypeError):
+            return None
+    ckpt = trial.get("checkpoint_dir")
+    if ckpt:
+        path = os.path.join(ckpt, "genotype.json")
+        try:
+            with open(path) as f:
+                return _darts_graph(json.load(f))
+        except (OSError, ValueError):
+            return None
+    return None
+
+
+# -- HTTP layer --------------------------------------------------------------
+
+
+class UiServer:
+    """Read-only dashboard server over a workdir + observation store."""
+
+    def __init__(self, workdir: str, store: ObservationStore | None = None):
+        self.workdir = workdir
+        self.store = store
+
+    # route handlers return (status, payload) with payload JSON-serializable
+
+    def experiments(self):
+        return 200, [_experiment_summary(s) for s in list_statuses(self.workdir)]
+
+    def experiment(self, name: str):
+        status = read_status(self.workdir, name)
+        if status is None:
+            return 404, {"error": f"experiment {name!r} not found"}
+        return 200, status
+
+    def trials(self, name: str):
+        status = read_status(self.workdir, name)
+        if status is None:
+            return 404, {"error": f"experiment {name!r} not found"}
+        return 200, _trial_rows(status)
+
+    def trial_metrics(self, trial_name: str):
+        if self.store is None:
+            return 503, {"error": "no observation store attached"}
+        logs = self.store.get(trial_name)
+        return 200, [
+            {
+                "metric_name": l.metric_name,
+                "value": l.value,
+                "timestamp": l.timestamp,
+                "step": l.step,
+            }
+            for l in logs
+        ]
+
+    def nas(self, name: str, trial_name: str | None):
+        status = read_status(self.workdir, name)
+        if status is None:
+            return 404, {"error": f"experiment {name!r} not found"}
+        trials = status.get("trials") or {}
+        if trial_name is None:
+            optimal = status.get("optimal") or {}
+            trial_name = optimal.get("trial_name")
+        if not trial_name or trial_name not in trials:
+            return 404, {"error": "no trial with a recoverable architecture"}
+        graph = nas_graph_for_trial(trials[trial_name])
+        if graph is None:
+            return 404, {"error": f"trial {trial_name!r} has no architecture artifact"}
+        graph["trial"] = trial_name
+        return 200, graph
+
+    def route(self, path: str, query: dict):
+        parts = [p for p in path.split("/") if p]
+        if not parts:
+            return "html", DASHBOARD_HTML
+        if parts[0] != "api":
+            return 404, {"error": "not found"}
+        if parts[1:] == ["experiments"]:
+            return self.experiments()
+        if len(parts) >= 3 and parts[1] == "experiment":
+            name = parts[2]
+            rest = parts[3:]
+            if not rest:
+                return self.experiment(name)
+            if rest == ["trials"]:
+                return self.trials(name)
+            if rest == ["nas"]:
+                return self.nas(name, (query.get("trial") or [None])[0])
+        if len(parts) == 4 and parts[1] == "trial" and parts[3] == "metrics":
+            return self.trial_metrics(parts[2])
+        return 404, {"error": "not found"}
+
+    # -- server lifecycle ----------------------------------------------------
+
+    def serve(self, port: int = 0, host: str = "127.0.0.1") -> "RunningUi":
+        ui = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                parsed = urlparse(self.path)
+                status, payload = ui.route(parsed.path, parse_qs(parsed.query))
+                if status == "html":
+                    body = payload.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/html; charset=utf-8")
+                else:
+                    body = json.dumps(payload, default=str).encode()
+                    self.send_response(status)
+                    self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        server = ThreadingHTTPServer((host, port), Handler)
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        return RunningUi(server, thread)
+
+
+class RunningUi:
+    def __init__(self, server: ThreadingHTTPServer, thread: threading.Thread):
+        self._server = server
+        self._thread = thread
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def start_ui(
+    workdir: str, store: ObservationStore | None = None, port: int = 0,
+    host: str = "127.0.0.1",
+) -> RunningUi:
+    return UiServer(workdir, store).serve(port=port, host=host)
+
+
+# -- the dashboard (single file, no build step) ------------------------------
+
+DASHBOARD_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>katib-tpu</title>
+<style>
+body{font-family:system-ui,sans-serif;margin:2rem;background:#fafafa;color:#222}
+h1{font-size:1.3rem} h2{font-size:1.05rem;margin-top:1.5rem}
+table{border-collapse:collapse;width:100%;background:#fff;box-shadow:0 1px 2px #0002}
+th,td{padding:.45rem .7rem;border-bottom:1px solid #eee;text-align:left;font-size:.88rem}
+th{background:#f0f0f3;font-weight:600}
+tr.sel{background:#eef4ff} tbody tr{cursor:pointer}
+.badge{padding:.1rem .45rem;border-radius:.6rem;font-size:.75rem;color:#fff}
+.Succeeded,.MaxTrialsReached,.GoalReached{background:#2e7d32}.Failed{background:#c62828}
+.Running{background:#1565c0}.EarlyStopped{background:#ef6c00}.MetricsUnavailable{background:#757575}
+#detail{margin-top:1rem} pre{background:#272822;color:#f8f8f2;padding:1rem;overflow:auto;font-size:.8rem}
+</style></head><body>
+<h1>katib-tpu experiments</h1>
+<table id="exps"><thead><tr><th>name</th><th>status</th><th>algorithm</th>
+<th>objective</th><th>trials</th><th>best</th></tr></thead><tbody></tbody></table>
+<div id="detail"></div>
+<script>
+const esc=s=>String(s??"").replace(/[&<>"]/g,c=>({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;"}[c]));
+const badge=c=>`<span class="badge ${esc(c)}">${esc(c)}</span>`;
+async function j(u){const r=await fetch(u);return r.json()}
+let current=null;
+async function refresh(){
+  const exps=await j('/api/experiments');
+  document.querySelector('#exps tbody').innerHTML=exps.map(e=>{
+    const c=e.counts||{},o=e.optimal;
+    return `<tr data-n="${esc(e.name)}" class="${e.name===current?'sel':''}">`+
+      `<td>${esc(e.name)}</td><td>${badge(e.condition)}</td><td>${esc(e.algorithm)}</td>`+
+      `<td>${esc(e.objective_metric)}</td><td>${c.succeeded??0}/${c.trials??0}</td>`+
+      `<td>${o?esc(o.objective_value?.toFixed?.(5)??o.objective_value):"—"}</td></tr>`;
+  }).join('');
+  document.querySelectorAll('#exps tbody tr').forEach(tr=>tr.onclick=()=>show(tr.dataset.n));
+  if(current)show(current,false);
+}
+async function show(name,re=true){
+  current=name;
+  const t=await j('/api/experiment/'+encodeURIComponent(name)+'/trials');
+  const cols=[...new Set(t.flatMap(r=>Object.keys(r.metrics||{})))];
+  const pcols=[...new Set(t.flatMap(r=>Object.keys(r.assignments||{})))];
+  document.getElementById('detail').innerHTML=
+    `<h2>${esc(name)} — trials</h2><table><thead><tr><th>trial</th><th>status</th>`+
+    pcols.map(p=>`<th>${esc(p)}</th>`).join('')+cols.map(c=>`<th>${esc(c)}</th>`).join('')+
+    `</tr></thead><tbody>`+t.map(r=>`<tr><td>${esc(r.name)}</td><td>${badge(r.condition)}</td>`+
+      pcols.map(p=>`<td>${esc(r.assignments?.[p])}</td>`).join('')+
+      cols.map(c=>{const v=r.metrics?.[c];return `<td>${v==null?"—":esc(v.toFixed?.(5)??v)}</td>`}).join('')+
+    `</tr>`).join('')+`</tbody></table>`;
+  if(re)refresh();
+}
+refresh();setInterval(refresh,3000);
+</script></body></html>
+"""

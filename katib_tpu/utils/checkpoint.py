@@ -1,0 +1,121 @@
+"""Trial checkpointing: Orbax pytree snapshots with step retention.
+
+Replaces the reference's three ad-hoc checkpoint mechanisms (SURVEY.md §5):
+PBT's ``shutil.copytree`` of opaque trial dirs on a RWX PVC
+(``pbt/service.py:259-268``), the ENAS controller's TF1 Saver
+(``enas/service.py:278``), and the simple-pbt example's pickle files
+(``pbt_test.py:49-66``).  Here every checkpoint is a JAX pytree written
+through Orbax — the same format on one chip or a v5e-64 mesh (Orbax handles
+sharded arrays natively), so PBT exploit copies, experiment resume, and
+preemption recovery all move the same artifacts.
+
+Layout under a trial's checkpoint directory::
+
+    <dir>/step_00000010/   # one Orbax PyTree checkpoint per retained step
+
+PBT lineage needs no special casing: the suggester copies the parent's
+whole directory tree before the child trial starts, and the child's
+``restore()`` picks up the parent's latest step.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+from typing import Any
+
+_STEP_DIR = re.compile(r"^step_(\d{8})$")
+
+
+def _step_path(directory: str, step: int) -> str:
+    return os.path.join(directory, f"step_{step:08d}")
+
+
+class TrialCheckpointer:
+    """Save/restore pytrees under one trial's checkpoint directory.
+
+    Orbax is imported lazily so trials that never checkpoint pay nothing.
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        if not directory:
+            raise ValueError("checkpoint directory is required")
+        self.directory = os.path.abspath(directory)
+        self.max_to_keep = max_to_keep
+        self._ckptr = None
+
+    def _checkpointer(self):
+        if self._ckptr is None:
+            import orbax.checkpoint as ocp
+
+            self._ckptr = ocp.PyTreeCheckpointer()
+        return self._ckptr
+
+    # -- queries -------------------------------------------------------------
+
+    def all_steps(self) -> list[int]:
+        if not os.path.isdir(self.directory):
+            return []
+        steps = []
+        for name in os.listdir(self.directory):
+            m = _STEP_DIR.match(name)
+            if m:
+                steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def latest_step(self) -> int | None:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    # -- save / restore ------------------------------------------------------
+
+    def save(self, pytree: Any, step: int, *, force: bool = True) -> str:
+        """Write ``pytree`` as the checkpoint for ``step``; prunes old steps
+        beyond ``max_to_keep``.  Returns the checkpoint path."""
+        os.makedirs(self.directory, exist_ok=True)
+        path = _step_path(self.directory, step)
+        if os.path.exists(path):
+            if not force:
+                raise FileExistsError(path)
+            shutil.rmtree(path)
+        self._checkpointer().save(path, pytree)
+        if self.max_to_keep is not None and self.max_to_keep > 0:
+            for old in self.all_steps()[: -self.max_to_keep]:
+                shutil.rmtree(_step_path(self.directory, old), ignore_errors=True)
+        return path
+
+    def restore(self, template: Any = None, step: int | None = None):
+        """Restore ``(pytree, step)``; ``None`` when no checkpoint exists.
+
+        ``template`` (a pytree of arrays or ShapeDtypeStructs) pins the
+        restored structure/sharding; without it Orbax returns its default
+        representation (nested dicts of numpy arrays).
+        """
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                return None
+        path = _step_path(self.directory, step)
+        if not os.path.isdir(path):
+            return None
+        if template is not None:
+            import orbax.checkpoint as ocp
+
+            restored = self._checkpointer().restore(
+                path, args=ocp.args.PyTreeRestore(template)
+            )
+        else:
+            restored = self._checkpointer().restore(path)
+        return restored, step
+
+
+def copy_checkpoint_tree(src_dir: str, dst_dir: str) -> bool:
+    """PBT exploit: clone a parent trial's full checkpoint lineage directory.
+    Returns False when the parent has nothing yet (the child cold-starts)."""
+    if not os.path.isdir(src_dir):
+        return False
+    if os.path.isdir(dst_dir):
+        shutil.rmtree(dst_dir)
+    shutil.copytree(src_dir, dst_dir)
+    return True

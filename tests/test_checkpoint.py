@@ -1,0 +1,130 @@
+"""Orbax trial checkpointing + PBT lineage e2e.
+
+Covers the capability the reference spreads across three mechanisms
+(SURVEY.md §5 checkpoint/resume): pytree save/restore, retention, the PBT
+parent→child directory clone, and a full PBT run over the toy triangle-wave
+workload (parity with the simple-pbt e2e)."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from katib_tpu.utils.checkpoint import TrialCheckpointer, copy_checkpoint_tree
+
+
+@pytest.fixture
+def ckpt_dir(tmp_path):
+    return str(tmp_path / "trial-a")
+
+
+class TestTrialCheckpointer:
+    def test_roundtrip_mixed_pytree(self, ckpt_dir):
+        ck = TrialCheckpointer(ckpt_dir)
+        tree = {
+            "params": {"w": jnp.arange(6.0).reshape(2, 3), "b": jnp.zeros(3)},
+            "step": jnp.asarray(7),
+            "rng": np.arange(4, dtype=np.uint32),
+        }
+        ck.save(tree, step=7)
+        restored, step = ck.restore()
+        assert step == 7
+        np.testing.assert_array_equal(restored["params"]["w"], tree["params"]["w"])
+        np.testing.assert_array_equal(restored["rng"], tree["rng"])
+        assert int(restored["step"]) == 7
+
+    def test_cold_start_returns_none(self, ckpt_dir):
+        assert TrialCheckpointer(ckpt_dir).restore() is None
+
+    def test_latest_and_retention(self, ckpt_dir):
+        ck = TrialCheckpointer(ckpt_dir, max_to_keep=2)
+        for s in (1, 5, 9):
+            ck.save({"x": jnp.asarray(float(s))}, step=s)
+        assert ck.all_steps() == [5, 9]  # step 1 pruned
+        restored, step = ck.restore()
+        assert step == 9 and float(restored["x"]) == 9.0
+        restored5, step5 = ck.restore(step=5)
+        assert step5 == 5 and float(restored5["x"]) == 5.0
+
+    def test_save_overwrites_same_step(self, ckpt_dir):
+        ck = TrialCheckpointer(ckpt_dir)
+        ck.save({"x": jnp.asarray(1.0)}, step=3)
+        ck.save({"x": jnp.asarray(2.0)}, step=3)
+        restored, _ = ck.restore()
+        assert float(restored["x"]) == 2.0
+
+    def test_lineage_copy(self, tmp_path):
+        parent = str(tmp_path / "parent")
+        child = str(tmp_path / "child")
+        TrialCheckpointer(parent).save({"x": jnp.asarray(4.0)}, step=2)
+        assert copy_checkpoint_tree(parent, child)
+        restored, step = TrialCheckpointer(child).restore()
+        assert step == 2 and float(restored["x"]) == 4.0
+        # cold parent -> child cold-starts
+        assert not copy_checkpoint_tree(str(tmp_path / "nope"), child)
+
+
+class TestContextCheckpointing:
+    def test_context_save_restore(self, tmp_path):
+        from katib_tpu.runner.context import TrialContext
+        from katib_tpu.store.base import MemoryObservationStore
+
+        ctx = TrialContext(
+            "t1", {}, MemoryObservationStore(), checkpoint_dir=str(tmp_path / "t1")
+        )
+        assert ctx.restore_checkpoint() is None
+        ctx.save_checkpoint({"v": jnp.asarray(3.0)}, step=1)
+        restored, step = ctx.restore_checkpoint()
+        assert step == 1 and float(restored["v"]) == 3.0
+
+
+class TestPbtToyEndToEnd:
+    def test_pbt_tracks_moving_optimum(self, tmp_path):
+        from katib_tpu.core.types import (
+            AlgorithmSpec,
+            ExperimentCondition,
+            ExperimentSpec,
+            FeasibleSpace,
+            ObjectiveSpec,
+            ObjectiveType,
+            ParameterSpec,
+            ParameterType,
+        )
+        from katib_tpu.models.pbt_toy import pbt_toy_trial
+        from katib_tpu.orchestrator import Orchestrator
+
+        spec = ExperimentSpec(
+            name="pbt-toy",
+            algorithm=AlgorithmSpec(
+                name="pbt",
+                settings={
+                    "n_population": "5",
+                    "truncation_threshold": "0.25",
+                    "suggestion_trial_dir": str(tmp_path / "pbt-ckpts"),
+                },
+            ),
+            objective=ObjectiveSpec(
+                type=ObjectiveType.MAXIMIZE, objective_metric_name="score"
+            ),
+            parameters=[
+                ParameterSpec(
+                    "lr", ParameterType.DOUBLE, FeasibleSpace(min=0.0001, max=0.02)
+                ),
+            ],
+            max_trial_count=15,
+            parallel_trial_count=2,
+            train_fn=pbt_toy_trial,
+        )
+        orch = Orchestrator(workdir=str(tmp_path / "runs"))
+        exp = orch.run(spec)
+        assert exp.condition is ExperimentCondition.MAX_TRIALS_REACHED
+        assert exp.optimal is not None and exp.optimal.objective_value > 0
+        # lineage: later generations exist, and exploited children inherited
+        # a parent checkpoint (their score continues rather than resetting)
+        gens = {t.spec.labels.get("pbt-generation") for t in exp.trials.values()}
+        assert len(gens) > 1
+        parented = [
+            t for t in exp.trials.values() if t.spec.labels.get("pbt-parent")
+        ]
+        assert parented, "no exploited members — truncation selection never fired"

@@ -1,0 +1,130 @@
+"""Slice allocator + multi-host init glue (parallel/distributed.py).
+
+The orchestrator e2e checks the TPU-native replacement for
+``parallelTrialCount`` pod scheduling: concurrent trials lease disjoint
+sub-meshes of the 8-device CPU platform."""
+
+import threading
+
+import jax
+import pytest
+
+from katib_tpu.parallel.distributed import (
+    SliceAllocator,
+    initialize_distributed,
+    topology_size,
+)
+from katib_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+class TestInitializeDistributed:
+    def test_single_process_is_noop(self, monkeypatch):
+        monkeypatch.delenv("COORDINATOR_ADDRESS", raising=False)
+        monkeypatch.delenv("NUM_PROCESSES", raising=False)
+        assert initialize_distributed() is False
+
+    def test_topology_sizes(self):
+        assert topology_size("v5e-8") == 8
+        assert topology_size("v5e-64") == 64
+        with pytest.raises(ValueError):
+            topology_size("v6e-9000")
+
+
+class TestSliceAllocator:
+    def test_partitions_devices_disjointly(self):
+        alloc = SliceAllocator(2, devices=jax.devices())
+        assert alloc.n_slices == 4
+        leases = [alloc.lease(timeout=1) for _ in range(4)]
+        seen = set()
+        for l in leases:
+            assert len(l.devices) == 2
+            assert not seen & set(l.devices)
+            seen.update(l.devices)
+        assert alloc.available() == 0
+        for l in leases:
+            alloc.release(l)
+        assert alloc.available() == 4
+
+    def test_lease_blocks_until_release(self):
+        alloc = SliceAllocator(4, devices=jax.devices())  # 2 slices
+        a = alloc.lease(timeout=1)
+        b = alloc.lease(timeout=1)
+        got = []
+
+        def taker():
+            got.append(alloc.lease(timeout=5))
+
+        t = threading.Thread(target=taker)
+        t.start()
+        alloc.release(a)
+        t.join(timeout=5)
+        assert got and got[0].index == a.index
+        alloc.release(b)
+        alloc.release(got[0])
+
+    def test_lease_timeout(self):
+        alloc = SliceAllocator(8, devices=jax.devices())  # 1 slice
+        l = alloc.lease(timeout=1)
+        with pytest.raises(TimeoutError):
+            alloc.lease(timeout=0.05)
+        alloc.release(l)
+
+    def test_double_release_rejected(self):
+        alloc = SliceAllocator(4, devices=jax.devices())
+        l = alloc.lease(timeout=1)
+        alloc.release(l)
+        with pytest.raises(ValueError):
+            alloc.release(l)
+
+    def test_mesh_axes_template(self):
+        alloc = SliceAllocator(
+            4, devices=jax.devices(), axes={DATA_AXIS: -1, MODEL_AXIS: 2}
+        )
+        with alloc.slice_mesh(timeout=1) as mesh:
+            assert mesh.shape[DATA_AXIS] == 2
+            assert mesh.shape[MODEL_AXIS] == 2
+
+
+class TestOrchestratorSliceScheduling:
+    def test_parallel_trials_get_disjoint_meshes(self):
+        from katib_tpu.core.types import (
+            AlgorithmSpec,
+            ExperimentCondition,
+            ExperimentSpec,
+            FeasibleSpace,
+            ObjectiveSpec,
+            ObjectiveType,
+            ParameterSpec,
+            ParameterType,
+        )
+        from katib_tpu.orchestrator import Orchestrator
+
+        seen = []
+        lock = threading.Lock()
+
+        def trainer(ctx):
+            devs = tuple(ctx.mesh.devices.flat)
+            with lock:
+                seen.append(devs)
+            ctx.report(accuracy=float(ctx.params["x"]), step=0)
+
+        spec = ExperimentSpec(
+            name="slice-sched",
+            algorithm=AlgorithmSpec(name="random"),
+            objective=ObjectiveSpec(
+                type=ObjectiveType.MAXIMIZE, objective_metric_name="accuracy"
+            ),
+            parameters=[
+                ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min=0.0, max=1.0)),
+            ],
+            max_trial_count=6,
+            parallel_trial_count=3,
+            train_fn=trainer,
+        )
+        alloc = SliceAllocator(2, devices=jax.devices())
+        exp = Orchestrator(slice_allocator=alloc).run(spec)
+        assert exp.condition is ExperimentCondition.MAX_TRIALS_REACHED
+        assert len(seen) == 6
+        assert all(len(d) == 2 for d in seen)
+        # every lease was returned
+        assert alloc.available() == alloc.n_slices

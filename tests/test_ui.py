@@ -1,0 +1,147 @@
+"""UI REST backend over the status journal + observation store.
+
+Parity target: the reference UI backend's endpoint set
+(``pkg/ui/v1beta1/backend.go:86,181,463``; NAS graph ``nas.go``) exercised
+through real HTTP against a journaled experiment."""
+
+import json
+import urllib.request
+
+import pytest
+
+from katib_tpu.core.types import (
+    AlgorithmSpec,
+    ExperimentSpec,
+    FeasibleSpace,
+    ObjectiveSpec,
+    ObjectiveType,
+    ParameterSpec,
+    ParameterType,
+)
+from katib_tpu.orchestrator import Orchestrator
+from katib_tpu.store.base import MemoryObservationStore
+from katib_tpu.ui import start_ui
+from katib_tpu.ui.backend import _darts_graph, _enas_graph, nas_graph_for_trial
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+        return r.status, r.headers.get("Content-Type"), r.read()
+
+
+@pytest.fixture(scope="class")
+def served(tmp_path_factory):
+    workdir = str(tmp_path_factory.mktemp("runs"))
+    store = MemoryObservationStore()
+
+    def trainer(ctx):
+        x = ctx.params["x"]
+        ctx.report(accuracy=1.0 - 0.1 * (x - 2.0) ** 2, step=0)
+
+    spec = ExperimentSpec(
+        name="ui-exp",
+        algorithm=AlgorithmSpec(name="random"),
+        objective=ObjectiveSpec(
+            type=ObjectiveType.MAXIMIZE, objective_metric_name="accuracy"
+        ),
+        parameters=[
+            ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min=0.0, max=4.0)),
+        ],
+        max_trial_count=3,
+        parallel_trial_count=1,
+        train_fn=trainer,
+    )
+    exp = Orchestrator(store=store, workdir=workdir).run(spec)
+    ui = start_ui(workdir, store)
+    yield ui.port, exp
+    ui.stop()
+
+
+class TestUiEndpoints:
+    def test_dashboard_html(self, served):
+        port, _ = served
+        status, ctype, body = _get(port, "/")
+        assert status == 200 and "text/html" in ctype
+        assert b"katib-tpu" in body
+
+    def test_list_experiments(self, served):
+        port, _ = served
+        status, _, body = _get(port, "/api/experiments")
+        exps = json.loads(body)
+        assert status == 200
+        assert [e["name"] for e in exps] == ["ui-exp"]
+        assert exps[0]["counts"]["succeeded"] == 3
+        assert exps[0]["optimal"] is not None
+
+    def test_experiment_detail_and_trials(self, served):
+        port, exp = served
+        status, _, body = _get(port, "/api/experiment/ui-exp")
+        detail = json.loads(body)
+        assert status == 200 and len(detail["trials"]) == 3
+
+        status, _, body = _get(port, "/api/experiment/ui-exp/trials")
+        rows = json.loads(body)
+        assert status == 200 and len(rows) == 3
+        assert all("x" in r["assignments"] for r in rows)
+        assert all("accuracy" in r["metrics"] for r in rows)
+
+    def test_trial_metrics_from_store(self, served):
+        port, exp = served
+        trial = next(iter(exp.trials))
+        status, _, body = _get(port, f"/api/trial/{trial}/metrics")
+        logs = json.loads(body)
+        assert status == 200 and logs
+        assert logs[0]["metric_name"] == "accuracy"
+
+    def test_unknown_routes_404(self, served):
+        port, _ = served
+        import urllib.error
+
+        for path in ("/api/experiment/nope", "/api/bogus"):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(port, path)
+            assert e.value.code == 404
+
+
+class TestNasGraphs:
+    def test_darts_graph_shape(self):
+        # per-node pair lists, the extract_genotype serialization
+        genotype = {
+            "normal": [
+                [["sep_conv_3x3", 0], ["skip_connect", 1]],
+                [["sep_conv_3x3", 2], ["max_pool_3x3", 0]],
+            ],
+            "reduce": [[["max_pool_3x3", 0], ["max_pool_3x3", 1]]],
+        }
+        g = _darts_graph(genotype)
+        assert g["type"] == "darts"
+        # 2 inputs + 2 normal nodes + 1 reduce node
+        assert len(g["nodes"]) == 5
+        assert len(g["edges"]) == 6
+        # node 1 of the normal cell consumes intermediate node 0 (src=2)
+        assert {"from": "normal-0", "to": "normal-1", "op": "sep_conv_3x3"} in g["edges"]
+
+    def test_enas_graph_shape(self):
+        arc = [[3], [1, 1], [0, 0, 1]]
+        g = _enas_graph(arc)
+        assert g["type"] == "enas"
+        assert len(g["nodes"]) == 5  # input + 3 layers + output
+        skips = [e for e in g["edges"] if e["op"] == "skip"]
+        assert len(skips) == 2
+
+    def test_recover_from_trial_assignment(self):
+        trial = {"assignments": {"architecture": json.dumps([[2], [1, 0]])}}
+        g = nas_graph_for_trial(trial)
+        assert g is not None and g["type"] == "enas"
+
+    def test_recover_from_genotype_file(self, tmp_path):
+        ckpt = tmp_path / "t0"
+        ckpt.mkdir()
+        (ckpt / "genotype.json").write_text(
+            json.dumps({"normal": [[["skip_connect", 0], ["none", 1]]], "reduce": []})
+        )
+        g = nas_graph_for_trial({"assignments": {}, "checkpoint_dir": str(ckpt)})
+        assert g is not None and g["type"] == "darts"
+
+    def test_no_artifact_returns_none(self, tmp_path):
+        assert nas_graph_for_trial({"assignments": {}, "checkpoint_dir": str(tmp_path)}) is None
