@@ -40,6 +40,15 @@ def main() -> None:
     want = os.environ.get("JAX_PLATFORMS")
     if want:
         jax.config.update("jax_platforms", want)
+    # persistent compilation cache: the bilevel DARTS step is a large XLA
+    # graph; warming the cache once makes every later bench run (and the
+    # driver's end-of-round run) skip the multi-minute compile
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    except Exception:
+        pass  # cache flags are version-dependent; the bench still runs
 
     from katib_tpu.nas.darts.architect import (
         DartsHyper,

@@ -362,6 +362,9 @@ class MetricsCollectorKind(str, enum.Enum):
     # TensorFlowEvent collector, ``common_types.go:212-215``); parsed after
     # the trial exits by ``runner/tfevent.py`` — no TF dependency.
     TFEVENT = "TensorFlowEvent"
+    # Scrape the trial's Prometheus exposition endpoint while it runs
+    # (reference Prometheus collector kind, ``common_types.go:216-219``).
+    PROMETHEUS = "Prometheus"
     NONE = "None"
 
 
@@ -371,10 +374,15 @@ class MetricsCollectorSpec:
 
     kind: MetricsCollectorKind = MetricsCollectorKind.PUSH
     # For FILE/JSONL collectors: path the black-box trial writes to.
+    # For PROMETHEUS: the HTTP path of the exposition endpoint (default
+    # ``/metrics``, reference ``common_types.go:47``).
     path: str | None = None
     # Metric line filter, default matches the reference's TEXT format regex
     # ``([\w|-]+)\s*=\s*([+-]?\d...)`` (``pkg/metricscollector/v1beta1/common/const.go``).
     filter: str | None = None
+    # PROMETHEUS only: port the trial listens on and scrape cadence.
+    port: int | None = None
+    scrape_interval: float = 1.0
 
 
 # ---------------------------------------------------------------------------

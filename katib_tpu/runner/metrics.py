@@ -155,6 +155,49 @@ def parse_text_lines_fast(
     return parse_text_lines(lines, metric_names)
 
 
+_PROM_SAMPLE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+([^\s]+)(?:\s+\d+)?$"
+)
+
+
+def parse_prometheus_samples(
+    text: str, metric_names: Sequence[str]
+) -> list[tuple[str, MetricLog]]:
+    """Parse Prometheus exposition format (reference Prometheus collector
+    kind, ``common_types.go:216-219``): ``name{labels} value [timestamp]``
+    samples; comment/HELP/TYPE lines skipped; only tracked base names kept;
+    NaN samples dropped like garbage TEXT values.
+
+    Returns ``(series_key, log)`` pairs where the key includes the label set
+    — scrapers must dedup per series, not per base name, or two labelled
+    series of one metric re-emit forever."""
+    names = set(metric_names)
+    out: list[tuple[str, MetricLog]] = []
+    ts = time.time()
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _PROM_SAMPLE.match(line)
+        if m is None or m.group(1) not in names:
+            continue
+        try:
+            value = float(m.group(3))
+        except ValueError:
+            continue
+        if not math.isfinite(value):
+            continue
+        key = m.group(1) + (m.group(2) or "")
+        out.append(
+            (key, MetricLog(metric_name=m.group(1), value=value, timestamp=ts))
+        )
+    return out
+
+
+def parse_prometheus_text(text: str, metric_names: Sequence[str]) -> list[MetricLog]:
+    return [log for _, log in parse_prometheus_samples(text, metric_names)]
+
+
 def objective_reported(logs: Sequence[MetricLog], objective_metric: str) -> bool:
     """Reference ``newObservationLog``: logs must contain at least one finite
     objective point, else the trial is MetricsUnavailable."""

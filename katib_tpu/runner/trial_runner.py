@@ -193,6 +193,46 @@ class _FileTailSource(_LineSource):
         return [rest] if rest.strip() else []
 
 
+class _PrometheusScraper:
+    """Polls the trial's exposition endpoint at the configured cadence;
+    reports a sample only when its value changed since the last scrape (each
+    scrape is a snapshot, not a stream — dedup keeps the store a series)."""
+
+    def __init__(self, collector, metric_names: list[str]):
+        path = collector.path or "/metrics"
+        if not path.startswith("/"):
+            path = "/" + path
+        port = collector.port or 8080
+        self.url = f"http://127.0.0.1:{port}{path}"
+        self.interval = max(0.05, collector.scrape_interval)
+        self.metric_names = metric_names
+        self._last_values: dict[str, float] = {}
+        self._next_scrape = 0.0
+
+    def poll(self):
+        from katib_tpu.runner.metrics import parse_prometheus_samples
+
+        now = time.monotonic()
+        if now < self._next_scrape:
+            return []
+        self._next_scrape = now + self.interval
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(self.url, timeout=0.5) as r:
+                text = r.read().decode(errors="replace")
+        except OSError:
+            return []  # endpoint not up yet / shutting down
+        out = []
+        # dedup per labelled series: two series of one base metric must not
+        # re-emit each other's snapshots every scrape
+        for key, log in parse_prometheus_samples(text, self.metric_names):
+            if self._last_values.get(key) != log.value:
+                self._last_values[key] = log.value
+                out.append(log)
+        return out
+
+
 def _run_blackbox(
     trial: Trial,
     store: ObservationStore,
@@ -215,9 +255,19 @@ def _run_blackbox(
         collector.path if collector.kind is MetricsCollectorKind.TFEVENT else None
     )
 
+    prom = (
+        _PrometheusScraper(collector, metric_names)
+        if collector.kind is MetricsCollectorKind.PROMETHEUS
+        else None
+    )
+
     def parse(lines: list[str]):
-        if tfevent_dir or collector.kind is MetricsCollectorKind.NONE:
-            return []  # metrics come from event files / nowhere, not stdout
+        if (
+            tfevent_dir
+            or prom is not None
+            or collector.kind is MetricsCollectorKind.NONE
+        ):
+            return []  # metrics come from event files / the endpoint, not stdout
         if collector.kind is MetricsCollectorKind.JSONL:
             # per-line so one malformed line (partial flush, stray diagnostic)
             # doesn't discard the valid lines polled in the same batch
@@ -251,7 +301,10 @@ def _run_blackbox(
     killed = False
     terminate_at: float | None = None
     while True:
-        for log in parse(source.poll()):
+        polled = parse(source.poll())
+        if prom is not None:
+            polled += prom.poll()
+        for log in polled:
             store.report(trial.name, [log])
             if evaluator.observe(log.metric_name, log.value):
                 early_stopped = True

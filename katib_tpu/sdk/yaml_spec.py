@@ -21,10 +21,13 @@ from katib_tpu.core.types import (
     EarlyStoppingSpec,
     ExperimentSpec,
     FeasibleSpace,
+    GraphConfig,
     MetricsCollectorKind,
     MetricsCollectorSpec,
     MetricStrategy,
     MetricStrategyType,
+    NasConfig,
+    NasOperation,
     ObjectiveSpec,
     ObjectiveType,
     ParameterSpec,
@@ -109,7 +112,8 @@ def _parse_collector(raw: Mapping[str, Any] | None) -> MetricsCollectorSpec:
     if not raw:
         return MetricsCollectorSpec(kind=MetricsCollectorKind.STDOUT)
     # CR shape: {collector: {kind}, source: {filter: {metricsFormat: [...]},
-    # fileSystemPath: {path, kind}}}; flat shape: {kind, path, filter}
+    # fileSystemPath: {path, kind}, httpGet: {port, path}}}; flat shape:
+    # {kind, path, filter, port, scrapeInterval}
     kind_raw = (raw.get("collector") or {}).get("kind", raw.get("kind", "StdOut"))
     try:
         kind = MetricsCollectorKind(kind_raw)
@@ -117,9 +121,44 @@ def _parse_collector(raw: Mapping[str, Any] | None) -> MetricsCollectorSpec:
         raise SpecError(f"unknown metrics collector kind {kind_raw!r}") from e
     source = raw.get("source") or {}
     formats = (source.get("filter") or {}).get("metricsFormat") or []
-    path = (source.get("fileSystemPath") or {}).get("path") or raw.get("path")
+    http_get = source.get("httpGet") or {}
+    path = (
+        (source.get("fileSystemPath") or {}).get("path")
+        or http_get.get("path")
+        or raw.get("path")
+    )
     filter_ = formats[0] if formats else raw.get("filter")
-    return MetricsCollectorSpec(kind=kind, path=path, filter=filter_)
+    port = http_get.get("port", raw.get("port"))
+    interval = raw.get("scrapeInterval", raw.get("scrape_interval", 1.0))
+    return MetricsCollectorSpec(
+        kind=kind,
+        path=path,
+        filter=filter_,
+        port=int(port) if port is not None else None,
+        scrape_interval=float(interval),
+    )
+
+
+def _parse_nas_config(raw: Mapping[str, Any] | None) -> NasConfig | None:
+    """CR shape (reference ``experiment_types.go:304-320``):
+    {graphConfig: {numLayers, inputSizes, outputSizes},
+     operations: [{operationType, parameters: [...]}]}."""
+    if not raw:
+        return None
+    gc_raw = raw.get("graphConfig") or raw.get("graph_config") or {}
+    graph = GraphConfig(
+        num_layers=int(gc_raw.get("numLayers", gc_raw.get("num_layers", 8))),
+        input_sizes=tuple(int(v) for v in gc_raw.get("inputSizes", gc_raw.get("input_sizes")) or ()),
+        output_sizes=tuple(int(v) for v in gc_raw.get("outputSizes", gc_raw.get("output_sizes")) or ()),
+    )
+    operations = tuple(
+        NasOperation(
+            operation_type=op.get("operationType", op.get("operation_type")),
+            parameters=tuple(_parse_parameter(p) for p in op.get("parameters") or ()),
+        )
+        for op in raw.get("operations") or ()
+    )
+    return NasConfig(graph_config=graph, operations=operations)
 
 
 def experiment_spec_from_dict(data: Mapping[str, Any]) -> ExperimentSpec:
@@ -184,6 +223,7 @@ def experiment_spec_from_dict(data: Mapping[str, Any]) -> ExperimentSpec:
         resume_policy=resume_policy,
         metrics_collector=_parse_collector(spec.get("metricsCollectorSpec")),
         command=[str(c) for c in command] if command else None,
+        nas_config=_parse_nas_config(spec.get("nasConfig")),
     )
 
 

@@ -11,6 +11,7 @@ from katib_tpu.suggest import grid  # noqa: F401
 from katib_tpu.suggest import hyperband  # noqa: F401
 from katib_tpu.suggest import pbt  # noqa: F401
 from katib_tpu.suggest import random_search  # noqa: F401
+from katib_tpu.suggest import service  # noqa: F401  (registers "remote")
 from katib_tpu.suggest import sobol  # noqa: F401
 from katib_tpu.suggest import tpe  # noqa: F401
 

@@ -1,0 +1,446 @@
+"""Suggestion algorithms as standalone services + the client-side proxy.
+
+The reference runs every algorithm as a per-experiment gRPC Deployment
+(composer ``composer.go:72``) that the controller dials through
+``SyncAssignments`` (``suggestionclient.go:83``: convert CRDs→proto, call
+``GetSuggestions``, write mutated algorithm settings back).  TPU-native the
+default is in-process (suggest/base.py), but the service form still matters:
+a long-lived ENAS controller on its own TPU, one suggester shared by many
+orchestrators, or isolation of heavyweight algorithm state.
+
+This module keeps the same three-call contract over plain HTTP/JSON:
+
+- ``POST /api/v1/validate``     {spec}                       ↔ ValidateAlgorithmSettings
+- ``POST /api/v1/suggestions``  {spec, trials, settings, count} ↔ GetSuggestions
+- ``GET  /healthz``                                          ↔ gRPC health servicer
+
+The server is **stateful per experiment** (hyperopt-store/ENAS-session/PBT-
+queue analogs live as the real Suggester instance keyed by experiment name);
+the reply carries the mutated ``algorithm_settings`` so stateless algorithms
+(Hyperband) round-trip their state through the caller exactly like the
+reference's state-in-CR trick (``suggestionclient.go:194-196``).
+
+Client side, ``RemoteSuggester`` registers as algorithm ``"remote"``::
+
+    algorithm:
+      name: remote
+      settings: {endpoint: "http://host:6789", algorithm: tpe}
+
+so the orchestrator treats a remote service like any other suggester,
+including its NotReady/Exhausted flow control (HTTP 409/410).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+from katib_tpu.core.types import (
+    ComparisonOp,
+    EarlyStoppingRule,
+    Experiment,
+    ExperimentSpec,
+    Metric,
+    Observation,
+    ParameterAssignment,
+    Trial,
+    TrialAssignmentSet,
+    TrialCondition,
+    TrialSpec,
+)
+from katib_tpu.suggest.base import (
+    SearchExhausted,
+    Suggester,
+    SuggesterError,
+    SuggestionsNotReady,
+    make_suggester,
+    register,
+)
+
+# ---------------------------------------------------------------------------
+# wire format (flat dict shapes; spec side reuses sdk.yaml_spec's parser)
+# ---------------------------------------------------------------------------
+
+
+def _param_to_wire(p) -> dict:
+    fs: dict[str, Any] = {"distribution": p.feasible.distribution.value}
+    if p.feasible.list is not None:
+        fs["list"] = list(p.feasible.list)
+    if p.feasible.min is not None:
+        fs["min"] = p.feasible.min
+    if p.feasible.max is not None:
+        fs["max"] = p.feasible.max
+    if p.feasible.step is not None:
+        fs["step"] = p.feasible.step
+    return {"name": p.name, "parameterType": p.type.value, "feasibleSpace": fs}
+
+
+def spec_to_wire(spec: ExperimentSpec) -> dict:
+    """Flat mapping accepted by ``experiment_spec_from_dict`` — the analog of
+    the controller's CRD→proto conversion (``suggestionclient.go:111-116``)."""
+    params = [_param_to_wire(p) for p in spec.parameters]
+    objective: dict[str, Any] = {
+        "type": spec.objective.type.value,
+        "objectiveMetricName": spec.objective.objective_metric_name,
+        "additionalMetricNames": list(spec.objective.additional_metric_names),
+        "metricStrategies": [
+            {"name": s.name, "value": s.value.value}
+            for s in spec.objective.metric_strategies
+        ],
+    }
+    if spec.objective.goal is not None:
+        objective["goal"] = spec.objective.goal
+    wire = {
+        "name": spec.name,
+        "objective": objective,
+        "algorithm": {"name": spec.algorithm.name, "settings": dict(spec.algorithm.settings)},
+        "parameters": params,
+        "parallelTrialCount": spec.parallel_trial_count,
+        "maxTrialCount": spec.max_trial_count,
+        "maxFailedTrialCount": spec.max_failed_trial_count,
+    }
+    if spec.nas_config is not None:
+        nc = spec.nas_config
+        wire["nasConfig"] = {
+            "graphConfig": {
+                "numLayers": nc.graph_config.num_layers,
+                "inputSizes": list(nc.graph_config.input_sizes),
+                "outputSizes": list(nc.graph_config.output_sizes),
+            },
+            "operations": [
+                {
+                    "operationType": op.operation_type,
+                    "parameters": [
+                        _param_to_wire(p) for p in op.parameters
+                    ],
+                }
+                for op in nc.operations
+            ],
+        }
+    return wire
+
+
+def trial_to_wire(t: Trial) -> dict:
+    return {
+        "name": t.name,
+        "condition": t.condition.value,
+        "assignments": [{"name": a.name, "value": a.value} for a in t.spec.assignments],
+        "labels": dict(t.spec.labels),
+        "start_time": t.start_time,
+        "observation": (
+            None
+            if t.observation is None
+            else [
+                {"name": m.name, "value": m.value, "min": m.min, "max": m.max, "latest": m.latest}
+                for m in t.observation.metrics
+            ]
+        ),
+    }
+
+
+def trial_from_wire(d: dict) -> Trial:
+    obs = None
+    if d.get("observation") is not None:
+        obs = Observation(
+            metrics=[
+                Metric(
+                    name=m["name"],
+                    value=m["value"],
+                    min=m.get("min", float("nan")),
+                    max=m.get("max", float("nan")),
+                    latest=m.get("latest", float("nan")),
+                )
+                for m in d["observation"]
+            ]
+        )
+    return Trial(
+        name=d["name"],
+        spec=TrialSpec(
+            assignments=[
+                ParameterAssignment(a["name"], a["value"])
+                for a in d.get("assignments") or ()
+            ],
+            labels=dict(d.get("labels") or {}),
+        ),
+        condition=TrialCondition(d.get("condition", "Created")),
+        observation=obs,
+        start_time=d.get("start_time", 0.0),
+    )
+
+
+def proposal_to_wire(p: TrialAssignmentSet) -> dict:
+    return {
+        "name": p.name,
+        "assignments": [{"name": a.name, "value": a.value} for a in p.assignments],
+        "labels": dict(p.labels),
+        "early_stopping_rules": [
+            {
+                "name": r.name,
+                "value": r.value,
+                "comparison": r.comparison.value,
+                "start_step": r.start_step,
+            }
+            for r in p.early_stopping_rules
+        ],
+    }
+
+
+def proposal_from_wire(d: dict) -> TrialAssignmentSet:
+    return TrialAssignmentSet(
+        assignments=[
+            ParameterAssignment(a["name"], a["value"]) for a in d.get("assignments") or ()
+        ],
+        name=d.get("name"),
+        labels=dict(d.get("labels") or {}),
+        early_stopping_rules=[
+            EarlyStoppingRule(
+                name=r["name"],
+                value=r["value"],
+                comparison=ComparisonOp(r["comparison"]),
+                start_step=r.get("start_step", 0),
+            )
+            for r in d.get("early_stopping_rules") or ()
+        ],
+    )
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+
+class _Entry:
+    def __init__(self, suggester: Suggester, fingerprint: str):
+        self.suggester = suggester
+        self.fingerprint = fingerprint
+        # serializes get_suggestions per experiment: stateful suggesters
+        # (TPE store / PBT queue / ENAS controller) are not thread-safe, and
+        # ThreadingHTTPServer handles each POST on its own thread
+        self.lock = threading.Lock()
+
+
+class SuggestionService:
+    """Holds the per-experiment suggester instances (the stateful analog of
+    one algorithm Deployment per experiment).  ``forget()`` /
+    ``DELETE /api/v1/experiment/<name>`` is the teardown path (the reference
+    deletes the Deployment on experiment completion,
+    ``suggestion_controller.go:132-143``)."""
+
+    def __init__(self):
+        self._entries: dict[str, _Entry] = {}
+        self._lock = threading.Lock()
+
+    def _spec_from_wire(self, payload: dict) -> ExperimentSpec:
+        from katib_tpu.sdk.yaml_spec import experiment_spec_from_dict
+
+        return experiment_spec_from_dict(payload["spec"])
+
+    @staticmethod
+    def _fingerprint(wire_spec: dict) -> str:
+        return json.dumps(wire_spec, sort_keys=True, default=str)
+
+    def validate(self, payload: dict) -> tuple[int, dict]:
+        try:
+            spec = self._spec_from_wire(payload)
+            make_suggester(spec)  # constructor runs validate()
+        except (SuggesterError, KeyError, ValueError) as e:
+            return 400, {"ok": False, "error": str(e)}
+        return 200, {"ok": True}
+
+    def forget(self, name: str) -> tuple[int, dict]:
+        with self._lock:
+            entry = self._entries.pop(name, None)
+        return (200, {"ok": True}) if entry else (404, {"error": f"unknown experiment {name!r}"})
+
+    def suggestions(self, payload: dict) -> tuple[int, dict]:
+        try:
+            spec = self._spec_from_wire(payload)
+            count = int(payload.get("count", 1))
+        except (KeyError, ValueError) as e:
+            return 400, {"error": f"bad request: {e}"}
+        fingerprint = self._fingerprint(payload["spec"])
+        try:
+            with self._lock:
+                entry = self._entries.get(spec.name)
+                # a re-used experiment name with a different spec gets a
+                # fresh suggester, not the stale one
+                if entry is None or entry.fingerprint != fingerprint:
+                    entry = _Entry(make_suggester(spec), fingerprint)
+                    self._entries[spec.name] = entry
+        except SuggesterError as e:
+            return 400, {"error": str(e)}
+        exp = Experiment(spec=spec)
+        exp.trials = {
+            t["name"]: trial_from_wire(t) for t in payload.get("trials") or ()
+        }
+        if payload.get("settings"):
+            exp.algorithm_settings = {
+                str(k): str(v) for k, v in payload["settings"].items()
+            }
+        try:
+            with entry.lock:
+                proposals = entry.suggester.get_suggestions(exp, count)
+        except SuggestionsNotReady as e:
+            return 409, {"error": str(e), "code": "not_ready"}
+        except SearchExhausted as e:
+            return 410, {"error": str(e), "code": "exhausted"}
+        except SuggesterError as e:
+            return 400, {"error": str(e)}
+        return 200, {
+            "suggestions": [proposal_to_wire(p) for p in proposals],
+            "algorithm_settings": dict(exp.algorithm_settings),
+        }
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def serve(self, port: int = 0, host: str = "127.0.0.1") -> "RunningService":
+        svc = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _reply(self, status: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                if self.path == "/healthz":
+                    self._reply(200, {"status": "serving"})
+                else:
+                    self._reply(404, {"error": "not found"})
+
+            def do_POST(self):  # noqa: N802
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                except (ValueError, OSError) as e:
+                    self._reply(400, {"error": f"bad payload: {e}"})
+                    return
+                if self.path == "/api/v1/suggestions":
+                    self._reply(*svc.suggestions(payload))
+                elif self.path == "/api/v1/validate":
+                    self._reply(*svc.validate(payload))
+                else:
+                    self._reply(404, {"error": "not found"})
+
+            def do_DELETE(self):  # noqa: N802
+                prefix = "/api/v1/experiment/"
+                if self.path.startswith(prefix):
+                    self._reply(*svc.forget(self.path[len(prefix):]))
+                else:
+                    self._reply(404, {"error": "not found"})
+
+            def log_message(self, *args):
+                pass
+
+        server = ThreadingHTTPServer((host, port), Handler)
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        return RunningService(server, thread)
+
+
+class RunningService:
+    def __init__(self, server: ThreadingHTTPServer, thread: threading.Thread):
+        self._server = server
+        self._thread = thread
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def serve_suggestions(port: int = 0, host: str = "127.0.0.1") -> RunningService:
+    return SuggestionService().serve(port=port, host=host)
+
+
+# ---------------------------------------------------------------------------
+# client proxy
+# ---------------------------------------------------------------------------
+
+
+@register("remote")
+class RemoteSuggester(Suggester):
+    """Proxy to a suggestion service — the orchestrator-side analog of
+    ``SyncAssignments`` (``suggestionclient.go:83``): ships spec + trial
+    history, receives assignments, writes mutated settings back."""
+
+    RETRIES = 3  # the reference's retry middleware does 10 @ 3s linear
+
+    @classmethod
+    def validate(cls, spec: ExperimentSpec) -> None:
+        if not spec.algorithm.setting("endpoint"):
+            raise SuggesterError("remote requires setting 'endpoint'")
+        if not spec.algorithm.setting("algorithm"):
+            raise SuggesterError("remote requires setting 'algorithm' (the real name)")
+
+    def __init__(self, spec: ExperimentSpec):
+        super().__init__(spec)
+        self.endpoint = spec.algorithm.setting("endpoint").rstrip("/")
+        self.algorithm = spec.algorithm.setting("algorithm")
+
+    def _wire_spec(self) -> dict:
+        wire = spec_to_wire(self.spec)
+        settings = {
+            k: v
+            for k, v in wire["algorithm"]["settings"].items()
+            if k not in ("endpoint", "algorithm")
+        }
+        wire["algorithm"] = {"name": self.algorithm, "settings": settings}
+        return wire
+
+    def _post(self, path: str, payload: dict) -> tuple[int, dict]:
+        data = json.dumps(payload).encode()
+        req = urllib.request.Request(
+            f"{self.endpoint}{path}", data=data,
+            headers={"Content-Type": "application/json"},
+        )
+        def safe_json(raw: bytes) -> dict:
+            # a proxy's HTML error page must not escape as JSONDecodeError
+            try:
+                out = json.loads(raw or b"{}")
+                return out if isinstance(out, dict) else {"error": str(out)}
+            except ValueError:
+                return {"error": raw[:200].decode(errors="replace")}
+
+        last: Exception | None = None
+        for _ in range(self.RETRIES):
+            try:
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    return r.status, safe_json(r.read())
+            except urllib.error.HTTPError as e:
+                return e.code, safe_json(e.read())
+            except OSError as e:
+                last = e
+        raise SuggestionsNotReady(f"suggestion service unreachable: {last}")
+
+    def get_suggestions(self, experiment: Experiment, count: int):
+        payload = {
+            "spec": self._wire_spec(),
+            "trials": [trial_to_wire(t) for t in experiment.trials.values()],
+            "settings": {
+                k: v
+                for k, v in experiment.algorithm_settings.items()
+                if k not in ("endpoint", "algorithm")
+            },
+            "count": count,
+        }
+        status, reply = self._post("/api/v1/suggestions", payload)
+        if status == 409:
+            raise SuggestionsNotReady(reply.get("error", "not ready"))
+        if status == 410:
+            raise SearchExhausted(reply.get("error", "exhausted"))
+        if status != 200:
+            raise SuggesterError(reply.get("error", f"service error {status}"))
+        for k, v in (reply.get("algorithm_settings") or {}).items():
+            experiment.algorithm_settings[str(k)] = str(v)
+        return [proposal_from_wire(p) for p in reply.get("suggestions") or ()]

@@ -1,0 +1,144 @@
+"""Prometheus metrics-collector kind (reference ``common_types.go:216-219``):
+black-box trials exposing an exposition endpoint get scraped live."""
+
+import socket
+import sys
+import textwrap
+
+from katib_tpu.core.types import (
+    MetricsCollectorKind,
+    MetricsCollectorSpec,
+    ObjectiveSpec,
+    ObjectiveType,
+    ParameterAssignment,
+    Trial,
+    TrialCondition,
+    TrialSpec,
+)
+from katib_tpu.runner.metrics import parse_prometheus_text
+from katib_tpu.runner.trial_runner import run_trial
+from katib_tpu.store.base import MemoryObservationStore
+
+OBJ = ObjectiveSpec(type=ObjectiveType.MAXIMIZE, objective_metric_name="accuracy")
+
+
+class TestParsePrometheusText:
+    def test_samples_labels_comments(self):
+        text = textwrap.dedent(
+            """\
+            # HELP accuracy model accuracy
+            # TYPE accuracy gauge
+            accuracy 0.75
+            accuracy{shard="1"} 0.80
+            loss{step="3"} 0.25 1700000000000
+            not_tracked 1.0
+            garbage
+            """
+        )
+        logs = parse_prometheus_text(text, ["accuracy", "loss"])
+        assert [(l.metric_name, l.value) for l in logs] == [
+            ("accuracy", 0.75),
+            ("accuracy", 0.80),
+            ("loss", 0.25),
+        ]
+
+    def test_nan_dropped(self):
+        logs = parse_prometheus_text("accuracy NaN\naccuracy 0.5", ["accuracy"])
+        assert [(l.metric_name, l.value) for l in logs] == [("accuracy", 0.5)]
+
+    def test_labelled_series_dedup_keys(self):
+        """Two label series of one base metric must dedup independently — a
+        scraper keyed on the base name would re-emit both forever."""
+        from katib_tpu.runner.metrics import parse_prometheus_samples
+
+        text = 'accuracy{shard="0"} 0.75\naccuracy{shard="1"} 0.80\n'
+        keys = [k for k, _ in parse_prometheus_samples(text, ["accuracy"])]
+        assert len(set(keys)) == 2
+
+    def test_scraper_stable_snapshot_emits_once(self):
+        from katib_tpu.core.types import MetricsCollectorSpec, MetricsCollectorKind
+        from katib_tpu.runner.trial_runner import _PrometheusScraper
+
+        scraper = _PrometheusScraper(
+            MetricsCollectorSpec(
+                kind=MetricsCollectorKind.PROMETHEUS, port=1, scrape_interval=0.05
+            ),
+            ["accuracy"],
+        )
+        text = 'accuracy{shard="0"} 0.75\naccuracy{shard="1"} 0.80\n'
+        from katib_tpu.runner.metrics import parse_prometheus_samples
+
+        def dedup(text):
+            out = []
+            for key, log in parse_prometheus_samples(text, ["accuracy"]):
+                if scraper._last_values.get(key) != log.value:
+                    scraper._last_values[key] = log.value
+                    out.append(log)
+            return out
+
+        assert len(dedup(text)) == 2  # first scrape: both series new
+        assert dedup(text) == []      # unchanged snapshot: nothing re-emitted
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+TRIAL_SCRIPT = textwrap.dedent(
+    """\
+    import sys, threading, time
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    port = int(sys.argv[1])
+    state = {"acc": 0.0}
+
+    class H(BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = ("# TYPE accuracy gauge\\naccuracy %.3f\\n" % state["acc"]).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", port), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    for i in range(6):
+        state["acc"] = (i + 1) / 10.0
+        time.sleep(0.25)
+    srv.shutdown()
+    """
+)
+
+
+class TestPrometheusBlackbox:
+    def test_scrapes_live_endpoint(self, tmp_path):
+        port = _free_port()
+        script = tmp_path / "trial.py"
+        script.write_text(TRIAL_SCRIPT)
+        trial = Trial(
+            name="prom-1",
+            spec=TrialSpec(
+                assignments=[ParameterAssignment("x", 1.0)],
+                command=[sys.executable, str(script), str(port)],
+                metrics_collector=MetricsCollectorSpec(
+                    kind=MetricsCollectorKind.PROMETHEUS,
+                    port=port,
+                    scrape_interval=0.1,
+                ),
+            ),
+        )
+        store = MemoryObservationStore()
+        result = run_trial(trial, store, OBJ)
+        assert result.condition is TrialCondition.SUCCEEDED, result.message
+        logs = store.get("prom-1")
+        values = [l.value for l in logs if l.metric_name == "accuracy"]
+        # deduped snapshots: strictly increasing series, several distinct points
+        assert len(values) >= 3
+        assert values == sorted(values)
+        assert values[-1] >= 0.5

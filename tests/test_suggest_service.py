@@ -1,0 +1,249 @@
+"""Suggestion-as-a-service: HTTP server + RemoteSuggester proxy.
+
+Mirrors the reference's suggestionclient tests (SyncAssignments over a live
+algorithm service, ``suggestionclient.go:83``) with a real in-process HTTP
+server instead of grpc_testing."""
+
+import json
+import urllib.request
+
+import pytest
+
+from katib_tpu.core.types import (
+    AlgorithmSpec,
+    ComparisonOp,
+    EarlyStoppingRule,
+    ExperimentCondition,
+    ExperimentSpec,
+    FeasibleSpace,
+    Metric,
+    Observation,
+    ObjectiveSpec,
+    ObjectiveType,
+    ParameterAssignment,
+    ParameterSpec,
+    ParameterType,
+    Trial,
+    TrialAssignmentSet,
+    TrialCondition,
+    TrialSpec,
+)
+from katib_tpu.orchestrator import Orchestrator
+from katib_tpu.suggest.service import (
+    SuggestionService,
+    proposal_from_wire,
+    proposal_to_wire,
+    spec_to_wire,
+    trial_from_wire,
+    trial_to_wire,
+)
+
+
+def _spec(algorithm="random", settings=None, **kw):
+    defaults = dict(
+        name=kw.pop("name", "svc-exp"),
+        algorithm=AlgorithmSpec(name=algorithm, settings=settings or {}),
+        objective=ObjectiveSpec(
+            type=ObjectiveType.MAXIMIZE, objective_metric_name="accuracy"
+        ),
+        parameters=[
+            ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min=0.0, max=4.0)),
+            ParameterSpec(
+                "opt", ParameterType.CATEGORICAL, FeasibleSpace(list=("sgd", "adam"))
+            ),
+        ],
+        max_trial_count=4,
+        parallel_trial_count=2,
+    )
+    defaults.update(kw)
+    return ExperimentSpec(**defaults)
+
+
+class TestWireFormat:
+    def test_spec_roundtrip(self):
+        from katib_tpu.sdk.yaml_spec import experiment_spec_from_dict
+
+        spec = _spec(algorithm="tpe", settings={"n_startup_trials": "3"})
+        wire = json.loads(json.dumps(spec_to_wire(spec)))
+        back = experiment_spec_from_dict(wire)
+        assert back.name == spec.name
+        assert back.algorithm.name == "tpe"
+        assert back.algorithm.settings == {"n_startup_trials": "3"}
+        assert [p.name for p in back.parameters] == ["x", "opt"]
+        assert back.parameters[0].feasible.max == 4.0
+        assert back.parameters[1].feasible.list == ("sgd", "adam")
+
+    def test_trial_roundtrip(self):
+        t = Trial(
+            name="t-1",
+            spec=TrialSpec(
+                assignments=[ParameterAssignment("x", 1.5)],
+                labels={"pbt-generation": "2"},
+            ),
+            condition=TrialCondition.SUCCEEDED,
+            observation=Observation(
+                metrics=[Metric(name="accuracy", value=0.9, latest=0.9)]
+            ),
+            start_time=12.5,
+        )
+        back = trial_from_wire(json.loads(json.dumps(trial_to_wire(t))))
+        assert back.name == "t-1"
+        assert back.condition is TrialCondition.SUCCEEDED
+        assert back.params() == {"x": 1.5}
+        assert back.labels == {"pbt-generation": "2"}
+        assert back.observation.get("accuracy").value == 0.9
+
+    def test_proposal_roundtrip(self):
+        p = TrialAssignmentSet(
+            assignments=[ParameterAssignment("x", 2.0)],
+            name="exp-abc",
+            labels={"gen": "1"},
+            early_stopping_rules=[
+                EarlyStoppingRule("accuracy", 0.4, ComparisonOp.LESS, start_step=3)
+            ],
+        )
+        back = proposal_from_wire(json.loads(json.dumps(proposal_to_wire(p))))
+        assert back.name == "exp-abc"
+        assert back.as_dict() == {"x": 2.0}
+        assert back.early_stopping_rules[0].comparison is ComparisonOp.LESS
+        assert back.early_stopping_rules[0].start_step == 3
+
+
+@pytest.fixture
+def service():
+    svc = SuggestionService().serve()
+    yield svc
+    svc.stop()
+
+
+class TestServiceEndpoints:
+    def test_healthz(self, service):
+        with urllib.request.urlopen(f"http://127.0.0.1:{service.port}/healthz") as r:
+            assert json.loads(r.read())["status"] == "serving"
+
+    def test_validate_rejects_bad_settings(self, service):
+        svc = SuggestionService()
+        status, reply = svc.validate(
+            {"spec": spec_to_wire(_spec(algorithm="pbt", settings={}))}
+        )
+        assert status == 400 and "pbt" in reply["error"]
+        status, reply = svc.validate({"spec": spec_to_wire(_spec())})
+        assert status == 200 and reply["ok"]
+
+    def test_suggestions_stateful_per_experiment(self):
+        svc = SuggestionService()
+        wire = spec_to_wire(_spec(algorithm="tpe"))
+        status, r1 = svc.suggestions({"spec": wire, "trials": [], "count": 2})
+        assert status == 200 and len(r1["suggestions"]) == 2
+        assert wire["name"] in svc._entries  # instance retained
+
+    def test_reused_name_with_new_spec_rebuilds(self):
+        svc = SuggestionService()
+        wire = spec_to_wire(_spec(algorithm="tpe"))
+        svc.suggestions({"spec": wire, "trials": [], "count": 1})
+        first = svc._entries[wire["name"]].suggester
+        wire2 = spec_to_wire(_spec(algorithm="random"))
+        svc.suggestions({"spec": wire2, "trials": [], "count": 1})
+        assert svc._entries[wire["name"]].suggester is not first
+
+    def test_forget_endpoint_evicts(self, service):
+        import urllib.request
+
+        wire = spec_to_wire(_spec())
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{service.port}/api/v1/suggestions",
+            data=json.dumps({"spec": wire, "trials": [], "count": 1}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as r:
+            assert r.status == 200
+        del_req = urllib.request.Request(
+            f"http://127.0.0.1:{service.port}/api/v1/experiment/{wire['name']}",
+            method="DELETE",
+        )
+        with urllib.request.urlopen(del_req) as r:
+            assert json.loads(r.read())["ok"]
+
+    def test_nas_config_on_the_wire(self):
+        from katib_tpu.core.types import GraphConfig, NasConfig, NasOperation
+        from katib_tpu.sdk.yaml_spec import experiment_spec_from_dict
+
+        spec = _spec(algorithm="enas")
+        spec.nas_config = NasConfig(
+            graph_config=GraphConfig(num_layers=4, input_sizes=(32, 32, 3), output_sizes=(10,)),
+            operations=(
+                NasOperation(
+                    operation_type="convolution",
+                    parameters=(
+                        ParameterSpec(
+                            "filter_size",
+                            ParameterType.CATEGORICAL,
+                            FeasibleSpace(list=("3", "5")),
+                        ),
+                    ),
+                ),
+            ),
+        )
+        back = experiment_spec_from_dict(json.loads(json.dumps(spec_to_wire(spec))))
+        assert back.nas_config is not None
+        assert back.nas_config.graph_config.num_layers == 4
+        assert back.nas_config.operations[0].operation_type == "convolution"
+        assert back.nas_config.operations[0].parameters[0].feasible.list == ("3", "5")
+
+
+class TestRemoteSuggesterEndToEnd:
+    def test_orchestrator_runs_against_remote_tpe(self, service):
+        def trainer(ctx):
+            x = ctx.params["x"]
+            ctx.report(accuracy=1.0 - 0.1 * (x - 2.0) ** 2, step=0)
+
+        spec = _spec(
+            algorithm="remote",
+            settings={
+                "endpoint": f"http://127.0.0.1:{service.port}",
+                "algorithm": "tpe",
+                "n_startup_trials": "2",
+            },
+            name="remote-tpe",
+            max_trial_count=5,
+            train_fn=trainer,
+        )
+        exp = Orchestrator().run(spec)
+        assert exp.condition is ExperimentCondition.MAX_TRIALS_REACHED
+        assert exp.completed_count == 5
+        assert exp.optimal is not None
+
+    def test_remote_grid_exhaustion_flows_through(self, service):
+        def trainer(ctx):
+            ctx.report(accuracy=float(ctx.params["x"]), step=0)
+
+        spec = ExperimentSpec(
+            name="remote-grid",
+            algorithm=AlgorithmSpec(
+                name="remote",
+                settings={
+                    "endpoint": f"http://127.0.0.1:{service.port}",
+                    "algorithm": "grid",
+                },
+            ),
+            objective=ObjectiveSpec(
+                type=ObjectiveType.MAXIMIZE, objective_metric_name="accuracy"
+            ),
+            parameters=[
+                ParameterSpec(
+                    "x", ParameterType.DOUBLE, FeasibleSpace(min=0.0, max=2.0, step=1.0)
+                ),
+            ],
+            max_trial_count=10,  # grid only has 3 points; exhaustion ends it
+            parallel_trial_count=2,
+            train_fn=trainer,
+        )
+        exp = Orchestrator().run(spec)
+        assert exp.condition is ExperimentCondition.SUCCEEDED
+        assert exp.completed_count == 3
+
+    def test_remote_requires_endpoint(self):
+        from katib_tpu.suggest.base import SuggesterError, make_suggester
+
+        with pytest.raises(SuggesterError):
+            make_suggester(_spec(algorithm="remote", settings={"algorithm": "tpe"}))
