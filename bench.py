@@ -16,6 +16,8 @@ from __future__ import annotations
 
 import json
 import os
+import sys
+import threading
 import time
 
 import jax
@@ -49,6 +51,26 @@ def main() -> None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
     except Exception:
         pass  # cache flags are version-dependent; the bench still runs
+
+    # device-init watchdog: a wedged TPU pool makes jax.devices() block
+    # forever (stale grant on the axon relay); fail fast instead of hanging
+    # the driver's bench run
+    init_done = threading.Event()
+    init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", "300"))
+
+    def watchdog():
+        if not init_done.wait(init_timeout):
+            print(
+                f"bench: device init did not complete in {init_timeout:.0f}s "
+                "(TPU pool wedged?); aborting",
+                file=sys.stderr,
+            )
+            os._exit(3)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+    n_devices = len(jax.devices())
+    init_done.set()
+    del n_devices
 
     from katib_tpu.nas.darts.architect import (
         DartsHyper,

@@ -10,6 +10,7 @@ observation store:
 - ``describe <experiment>``   trials, assignments, observations, optimal
 - ``metrics <trial>``         raw metric log for one trial
 - ``ui``                      serve the REST API + HTML dashboard
+- ``conformance``             packaged e2e invariants check (conformance/run.sh parity)
 - ``doctor``                  environment report (devices, native runtime)
 """
 
@@ -158,6 +159,79 @@ def cmd_metrics(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_conformance(args: argparse.Namespace) -> int:
+    """Packaged conformance run (parity with the reference's
+    ``conformance/run.sh``: deploy, run random-search e2e, assert the
+    invariants from ``run-e2e-experiment.py:52-60``)."""
+    import tempfile
+
+    from katib_tpu.core.types import (
+        AlgorithmSpec,
+        ExperimentCondition,
+        ExperimentSpec,
+        FeasibleSpace,
+        ObjectiveSpec,
+        ObjectiveType,
+        ParameterSpec,
+        ParameterType,
+    )
+    from katib_tpu.orchestrator import Orchestrator
+
+    def trainer(ctx):
+        x = float(ctx.params["lr"])
+        n = int(ctx.params["num_layers"])
+        acc = 1.0 - 0.2 * (x - 0.05) ** 2 - 0.01 * abs(n - 3)
+        for step in range(3):
+            if not ctx.report(step=step, accuracy=acc * (step + 1) / 3):
+                return
+
+    spec = ExperimentSpec(
+        name="conformance-random",
+        algorithm=AlgorithmSpec(name="random"),
+        objective=ObjectiveSpec(
+            type=ObjectiveType.MAXIMIZE, objective_metric_name="accuracy"
+        ),
+        parameters=[
+            ParameterSpec(
+                "lr", ParameterType.DOUBLE, FeasibleSpace(min=0.01, max=0.2)
+            ),
+            ParameterSpec(
+                "num_layers", ParameterType.INT, FeasibleSpace(min=1, max=5)
+            ),
+        ],
+        max_trial_count=args.max_trials,
+        parallel_trial_count=2,
+        train_fn=trainer,
+    )
+    with tempfile.TemporaryDirectory(prefix="katib-conformance-") as workdir:
+        exp = Orchestrator(workdir=workdir).run(spec)
+
+    failures = []
+    if exp.optimal is None:
+        failures.append("best objective missing")
+    if (
+        exp.condition is ExperimentCondition.MAX_TRIALS_REACHED
+        and exp.completed_count != spec.max_trial_count
+    ):
+        failures.append(
+            f"MaxTrialsReached but completed {exp.completed_count} != {spec.max_trial_count}"
+        )
+    if exp.condition not in (
+        ExperimentCondition.MAX_TRIALS_REACHED,
+        ExperimentCondition.GOAL_REACHED,
+        ExperimentCondition.SUCCEEDED,
+    ):
+        failures.append(f"experiment ended {exp.condition.value}: {exp.message}")
+    if failures:
+        print("CONFORMANCE FAIL: " + "; ".join(failures), file=sys.stderr)
+        return 1
+    print(
+        f"CONFORMANCE PASS: {exp.condition.value}, "
+        f"{exp.completed_count} trials, best={exp.optimal.objective_value:.4f}"
+    )
+    return 0
+
+
 def cmd_ui(args: argparse.Namespace) -> int:
     from katib_tpu.ui import start_ui
 
@@ -221,6 +295,10 @@ def main(argv: list[str] | None = None) -> int:
     p = sub.add_parser("metrics", help="dump a trial's metric log")
     p.add_argument("trial")
     p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser("conformance", help="packaged e2e invariants check")
+    p.add_argument("--max-trials", type=int, default=8)
+    p.set_defaults(fn=cmd_conformance)
 
     p = sub.add_parser("ui", help="serve the REST API + dashboard")
     p.add_argument("--workdir", default="katib_runs")

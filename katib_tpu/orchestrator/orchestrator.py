@@ -220,6 +220,16 @@ class Orchestrator:
             exp.update_optimal()
             self._finish(exp)
             raise
+          finally:
+            # suggester teardown (remote services evict their per-experiment
+            # state — the analog of deleting the algorithm Deployment,
+            # ``suggestion_controller.go:132-143``); best-effort
+            closer = getattr(suggester, "close", None)
+            if closer is not None:
+                try:
+                    closer(exp)
+                except Exception:
+                    pass
 
     # -- internals ----------------------------------------------------------
 

@@ -218,11 +218,15 @@ class _PrometheusScraper:
         self._next_scrape = now + self.interval
         import urllib.request
 
+        import http.client
+
         try:
             with urllib.request.urlopen(self.url, timeout=0.5) as r:
                 text = r.read().decode(errors="replace")
-        except OSError:
-            return []  # endpoint not up yet / shutting down
+        except (OSError, http.client.HTTPException):
+            # endpoint not up yet / shutting down / half-closed socket
+            # (BadStatusLine is not an OSError) — never fail the trial
+            return []
         out = []
         # dedup per labelled series: two series of one base metric must not
         # re-emit each other's snapshots every scrape

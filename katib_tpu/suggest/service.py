@@ -382,6 +382,16 @@ class RemoteSuggester(Suggester):
             raise SuggesterError("remote requires setting 'endpoint'")
         if not spec.algorithm.setting("algorithm"):
             raise SuggesterError("remote requires setting 'algorithm' (the real name)")
+        if spec.algorithm.setting("algorithm") == "pbt":
+            # PBT's exploit step copies checkpoint directories, which live on
+            # the orchestrator host; a remote PbtSuggester would allocate its
+            # lineage on the service host and children would silently cold-
+            # start (reference PBT equally requires the shared RWX PVC)
+            raise SuggesterError(
+                "pbt cannot run behind 'remote': checkpoint lineage requires "
+                "the suggester and trials to share a filesystem — run pbt "
+                "in-process"
+            )
 
     def __init__(self, spec: ExperimentSpec):
         super().__init__(spec)
@@ -412,6 +422,8 @@ class RemoteSuggester(Suggester):
             except ValueError:
                 return {"error": raw[:200].decode(errors="replace")}
 
+        import http.client
+
         last: Exception | None = None
         for _ in range(self.RETRIES):
             try:
@@ -419,7 +431,10 @@ class RemoteSuggester(Suggester):
                     return r.status, safe_json(r.read())
             except urllib.error.HTTPError as e:
                 return e.code, safe_json(e.read())
-            except OSError as e:
+            except (OSError, http.client.HTTPException) as e:
+                # half-closed connections raise BadStatusLine (not OSError);
+                # both are transient — retry, then surface as NotReady so a
+                # glitch never fails the experiment
                 last = e
         raise SuggestionsNotReady(f"suggestion service unreachable: {last}")
 
@@ -444,3 +459,18 @@ class RemoteSuggester(Suggester):
         for k, v in (reply.get("algorithm_settings") or {}).items():
             experiment.algorithm_settings[str(k)] = str(v)
         return [proposal_from_wire(p) for p in reply.get("suggestions") or ()]
+
+    def close(self, experiment: Experiment) -> None:
+        """Teardown on experiment completion: evict the server-side suggester
+        (the reference deletes the per-experiment Deployment,
+        ``suggestion_controller.go:132-143``).  Best-effort — the service may
+        already be gone."""
+        import http.client
+
+        req = urllib.request.Request(
+            f"{self.endpoint}/api/v1/experiment/{self.spec.name}", method="DELETE"
+        )
+        try:
+            urllib.request.urlopen(req, timeout=10).close()
+        except (OSError, urllib.error.HTTPError, http.client.HTTPException):
+            pass

@@ -275,3 +275,14 @@ class TestObservability:
                 )
         finally:
             server.stop()
+
+
+class TestConformanceCommand:
+    def test_conformance_passes(self, capsys):
+        from katib_tpu.cli import main
+
+        rc = main(["conformance", "--max-trials", "4"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "CONFORMANCE PASS" in out
+        assert "MaxTrialsReached" in out

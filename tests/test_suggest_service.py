@@ -247,3 +247,42 @@ class TestRemoteSuggesterEndToEnd:
 
         with pytest.raises(SuggesterError):
             make_suggester(_spec(algorithm="remote", settings={"algorithm": "tpe"}))
+
+    def test_remote_pbt_rejected(self):
+        from katib_tpu.suggest.base import SuggesterError, make_suggester
+
+        with pytest.raises(SuggesterError, match="share a filesystem"):
+            make_suggester(
+                _spec(
+                    algorithm="remote",
+                    settings={"endpoint": "http://x:1", "algorithm": "pbt"},
+                )
+            )
+
+    def test_orchestrator_evicts_remote_state_on_completion(self, service):
+        def trainer(ctx):
+            ctx.report(accuracy=float(ctx.params["x"]), step=0)
+
+        spec = _spec(
+            algorithm="remote",
+            settings={
+                "endpoint": f"http://127.0.0.1:{service.port}",
+                "algorithm": "random",
+            },
+            name="remote-evict",
+            max_trial_count=2,
+            train_fn=trainer,
+        )
+        exp = Orchestrator().run(spec)
+        assert exp.completed_count == 2
+        # the DELETE teardown removed the per-experiment suggester entry;
+        # list the server's entries through a follow-up DELETE: 404 == gone
+        import urllib.error
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{service.port}/api/v1/experiment/remote-evict",
+            method="DELETE",
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req)
+        assert e.value.code == 404
