@@ -606,6 +606,9 @@ class ExperimentSpec:
     train_fn: Callable[..., Any] | None = None
     # Black-box alternative: argv template with ${trialParameters.X} placeholders.
     command: list[str] | None = None
+    # Keep trial artifacts (checkpoint steps) after successful completion
+    # (reference ``trialTemplate.retain``, ``trial_types.go:57``).
+    retain: bool = False
 
     def parameter(self, name: str) -> ParameterSpec:
         for p in self.parameters:

@@ -56,7 +56,16 @@ def init_alphas(
 
 class Cell(nn.Module):
     """One DARTS cell (reference ``model.py:21``): nodes connected by mixed
-    ops; output = channel-concat of the intermediate nodes."""
+    ops; output = channel-concat of the intermediate nodes.
+
+    Edges are evaluated through ``nn.vmap`` groups — all of a node's incoming
+    edges with the same stride share ONE traced MixedOp with stacked
+    parameters.  Identical math to per-edge modules (vmapped batch-norm
+    statistics are per-edge), but the XLA graph carries one mixed-op trace
+    per group instead of one per edge: the bilevel DARTS step at reference
+    scale (8 cells x 14 edges x 8 primitives, x4 passes) is otherwise tens
+    of thousands of convolutions and multi-minute (CPU: unbounded) compiles.
+    """
 
     primitives: Sequence[str]
     channels: int
@@ -74,17 +83,34 @@ class Cell(nn.Module):
             s0 = ReluConvBn(self.channels, dtype=self.dtype)(s0)
         s1 = ReluConvBn(self.channels, dtype=self.dtype)(s1)
 
+        VmappedMixedOp = nn.vmap(
+            MixedOp,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            in_axes=(0, 0),
+            out_axes=0,
+        )
+
+        def edge_group(states_group, w_rows, stride):
+            # [k, N, H, W, C] states + [k, n_ops] weight rows -> [k, N, H', W', C]
+            return VmappedMixedOp(
+                self.primitives, self.channels, stride, dtype=self.dtype
+            )(jnp.stack(states_group), w_rows)
+
         states = [s0, s1]
         offset = 0
         for node in range(self.n_nodes):
-            total = None
-            for i, h in enumerate(states):
-                stride = 2 if self.reduction and i < 2 else 1
-                out = MixedOp(
-                    self.primitives, self.channels, stride, dtype=self.dtype
-                )(h, weights[offset + i])
-                total = out if total is None else total + out
-            offset += len(states)
+            k = len(states)
+            w_rows = weights[offset : offset + k]
+            if self.reduction:
+                # cell inputs reduce spatially (stride 2); intermediate
+                # states are already reduced (stride 1)
+                total = edge_group(states[:2], w_rows[:2], 2).sum(axis=0)
+                if k > 2:
+                    total = total + edge_group(states[2:], w_rows[2:], 1).sum(axis=0)
+            else:
+                total = edge_group(states, w_rows, 1).sum(axis=0)
+            offset += k
             states.append(total)
         return jnp.concatenate(states[2:], axis=-1)
 

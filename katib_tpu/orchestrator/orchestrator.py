@@ -26,6 +26,7 @@ from __future__ import annotations
 import concurrent.futures as cf
 import os
 import secrets
+import shutil
 import threading
 import time
 import traceback
@@ -82,6 +83,9 @@ class Orchestrator:
         # own wind-down event for in-flight trials
         self._stop_requested = threading.Event()
         self._stop_event = threading.Event()
+        # trials whose checkpoint dir belongs to the suggester (PBT lineage)
+        # — exempt from retain-cleanup
+        self._suggester_owned_ckpts: set[str] = set()
 
     def stop(self) -> None:
         """Request the experiment wind down (the reference's experiment
@@ -241,6 +245,7 @@ class Orchestrator:
         # PBT pre-populates lineage checkpoints in its own directory layout
         if hasattr(suggester, "checkpoint_dir_for"):
             ckpt = suggester.checkpoint_dir_for(name)
+            self._suggester_owned_ckpts.add(name)
         else:
             ckpt = os.path.join(self.workdir, exp.name, name)
         trial = Trial(
@@ -253,6 +258,7 @@ class Orchestrator:
                 train_fn=exp.spec.train_fn,
                 command=list(exp.spec.command) if exp.spec.command else None,
                 metrics_collector=exp.spec.metrics_collector,
+                retain=exp.spec.retain,
             ),
             condition=TrialCondition.RUNNING,
             start_time=time.time(),
@@ -374,9 +380,33 @@ class Orchestrator:
             counter = self._TRIAL_COUNTERS.get(trial.condition)
             if counter is not None:
                 counter.inc()
+            self._cleanup_trial(trial)
             exp.update_optimal()
         if done:
             self._publish(exp)
+
+    def _cleanup_trial(self, trial: Trial) -> None:
+        """Honor ``retain`` (the reference deletes the trial job on
+        completion unless retained, ``trial_controller.go:297-306``): prune
+        the bulky Orbax step directories of an orchestrator-owned checkpoint
+        dir, keeping small artifacts (genotype.json, profiles).  Suggester-
+        owned dirs (PBT lineage) are never touched — exploit copies need
+        parent weights after the parent completes."""
+        if (
+            trial.spec.retain
+            or trial.checkpoint_dir is None
+            or trial.name in self._suggester_owned_ckpts
+            or trial.condition is not TrialCondition.SUCCEEDED
+        ):
+            return
+        from katib_tpu.utils.checkpoint import TrialCheckpointer, _step_path
+
+        try:
+            ck = TrialCheckpointer(trial.checkpoint_dir, max_to_keep=0)
+            for step in ck.all_steps():
+                shutil.rmtree(_step_path(trial.checkpoint_dir, step), ignore_errors=True)
+        except (OSError, ValueError):
+            pass
 
     @staticmethod
     def _budget_used(exp: Experiment) -> int:

@@ -224,6 +224,7 @@ def experiment_spec_from_dict(data: Mapping[str, Any]) -> ExperimentSpec:
         metrics_collector=_parse_collector(spec.get("metricsCollectorSpec")),
         command=[str(c) for c in command] if command else None,
         nas_config=_parse_nas_config(spec.get("nasConfig")),
+        retain=bool(spec.get("retain", template.get("retain", False))),
     )
 
 

@@ -1,0 +1,60 @@
+"""``retain`` semantics: orchestrator prunes successful trials' checkpoint
+steps unless retained (reference deletes the trial job unless ``retain``,
+``trial_controller.go:297-306``); PBT lineage dirs are exempt."""
+
+import os
+
+import jax.numpy as jnp
+
+from katib_tpu.core.types import (
+    AlgorithmSpec,
+    ExperimentSpec,
+    FeasibleSpace,
+    ObjectiveSpec,
+    ObjectiveType,
+    ParameterSpec,
+    ParameterType,
+)
+from katib_tpu.orchestrator import Orchestrator
+
+
+def _spec(tmp_path, retain: bool, name: str):
+    def trainer(ctx):
+        ctx.save_checkpoint({"w": jnp.ones(4)}, step=1)
+        ctx.report(accuracy=0.9, step=0)
+
+    return ExperimentSpec(
+        name=name,
+        algorithm=AlgorithmSpec(name="random"),
+        objective=ObjectiveSpec(
+            type=ObjectiveType.MAXIMIZE, objective_metric_name="accuracy"
+        ),
+        parameters=[
+            ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min=0.0, max=1.0)),
+        ],
+        max_trial_count=2,
+        parallel_trial_count=1,
+        train_fn=trainer,
+        retain=retain,
+    )
+
+
+def _step_dirs(trial):
+    d = trial.checkpoint_dir
+    if not os.path.isdir(d):
+        return []
+    return [n for n in os.listdir(d) if n.startswith("step_")]
+
+
+class TestRetain:
+    def test_default_prunes_checkpoint_steps(self, tmp_path):
+        spec = _spec(tmp_path, retain=False, name="no-retain")
+        exp = Orchestrator(workdir=str(tmp_path / "runs")).run(spec)
+        for t in exp.trials.values():
+            assert _step_dirs(t) == [], "steps should be pruned by default"
+
+    def test_retain_keeps_checkpoints(self, tmp_path):
+        spec = _spec(tmp_path, retain=True, name="retained")
+        exp = Orchestrator(workdir=str(tmp_path / "runs")).run(spec)
+        for t in exp.trials.values():
+            assert _step_dirs(t), "retained trials keep their checkpoints"
