@@ -61,7 +61,22 @@ def cmd_run(args: argparse.Namespace) -> int:
         )
         return 2
     orch = cfg.make_orchestrator()
-    exp = orch.run(spec)
+    if args.resume:
+        existing = orch.load_experiment(spec)
+        if existing is None:
+            print(
+                f"note: no journal for {spec.name!r} under {orch.workdir}; "
+                "starting fresh",
+                file=sys.stderr,
+            )
+        try:
+            exp = orch.run(spec, experiment=existing)
+        except RuntimeError as e:
+            # e.g. terminal experiment with resumePolicy: Never
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+    else:
+        exp = orch.run(spec)
     status = "ok" if exp.condition.value != "Failed" else "FAILED"
     print(f"experiment {exp.name}: {exp.condition.value} ({exp.message}) [{status}]")
     if exp.optimal is not None:
@@ -280,6 +295,11 @@ def main(argv: list[str] | None = None) -> int:
     p = sub.add_parser("run", help="run an experiment from a YAML spec")
     p.add_argument("experiment")
     p.add_argument("--workdir", default=None)
+    p.add_argument(
+        "--resume",
+        action="store_true",
+        help="resume from the status journal (honors spec resumePolicy)",
+    )
     p.set_defaults(fn=cmd_run)
 
     p = sub.add_parser("list", help="list experiments")

@@ -96,15 +96,33 @@ class Orchestrator:
 
     # -- public API ---------------------------------------------------------
 
-    def run(self, spec: ExperimentSpec, experiment: Experiment | None = None) -> Experiment:
+    def load_experiment(self, spec: ExperimentSpec) -> Experiment | None:
+        """Reconstruct a previously journaled experiment from the workdir
+        (``status.json``), or None when no journal exists.  Pass the result
+        to :meth:`run` to resume across a process restart (the reference
+        resurrects experiments from CR state + the suggestion PVC,
+        ``suggestion_controller.go:181-193``)."""
+        from katib_tpu.orchestrator.resume import load_experiment
+
+        return load_experiment(spec, self.workdir)
+
+    def run(
+        self,
+        spec: ExperimentSpec,
+        experiment: Experiment | None = None,
+        resume: bool = False,
+    ) -> Experiment:
         """Run an experiment to a terminal condition; returns it with full
         trial history and optimal-trial status.  Pass an existing
-        ``experiment`` to resume (``ResumePolicy`` semantics: a completed
+        ``experiment`` — or ``resume=True`` to load one from the status
+        journal — to resume (``ResumePolicy`` semantics: a completed
         experiment re-opens when ``max_trial_count`` was raised, reference
         ``experiment_controller.go:187-206``)."""
         if self.config is not None:
             spec = self.config.apply_to(spec)
         validate_experiment(spec)
+        if resume and experiment is None:
+            experiment = self.load_experiment(spec)
         exp = experiment or Experiment(spec=spec)
         if experiment is not None:
             exp.spec = spec
@@ -117,6 +135,16 @@ class Orchestrator:
                 exp.completion_time = 0.0
 
         suggester = make_suggester(spec)
+        # restore durable suggester state (ENAS controller pytree, PBT job
+        # queue) — the FromVolume PVC analog.  Never-policy experiments keep
+        # no state on disk, matching the reference tearing the service down
+        # with nothing to resurrect from.
+        if experiment is not None and spec.resume_policy is not ResumePolicy.NEVER:
+            from katib_tpu.orchestrator.resume import load_suggester_state
+
+            load_suggester_state(suggester, self.workdir, exp.name)
+        if experiment is not None:
+            self._backfill_store(exp)
         early_stopper = make_early_stopper(spec)
         if early_stopper is not None and hasattr(early_stopper, "bind_store"):
             early_stopper.bind_store(self.store)
@@ -154,6 +182,19 @@ class Orchestrator:
             max_workers=spec.parallel_trial_count, thread_name_prefix=f"trial-{exp.name}"
         ) as pool:
           try:
+            # resubmit trials orphaned by a process restart (journaled
+            # non-terminal → PENDING): same name/assignments/checkpoint dir,
+            # so a checkpoint-aware train_fn resumes mid-trial — the analog
+            # of trial jobs surviving a controller restart in the reference
+            for trial in exp.trials.values():
+                if trial.condition in (TrialCondition.PENDING, TrialCondition.CREATED):
+                    if early_stopper is not None and not trial.spec.early_stopping_rules:
+                        trial.spec.early_stopping_rules = early_stopper.get_rules(exp)
+                    if hasattr(suggester, "checkpoint_dir_for"):
+                        self._suggester_owned_ckpts.add(trial.name)
+                    trial.condition = TrialCondition.RUNNING
+                    trial.start_time = time.time()
+                    futures[pool.submit(self._execute, exp, trial, mesh)] = trial
             while True:
                 self._harvest(exp, futures)
                 if self._stop_requested.is_set():
@@ -193,6 +234,11 @@ class Orchestrator:
                     for proposal in proposals:
                         trial = self._materialize(exp, proposal, early_stopper, suggester)
                         futures[pool.submit(self._execute, exp, trial, mesh)] = trial
+                    if proposals:
+                        self._persist_suggester(exp, suggester)
+                        # journal the newly in-flight trials so a crash here
+                        # leaves resubmittable orphans (and the UI sees them)
+                        self._publish(exp)
 
                 # livelock guard: nothing running, nothing proposed, not
                 # exhausted — a buggy suggester would spin here forever
@@ -225,6 +271,9 @@ class Orchestrator:
             self._finish(exp)
             raise
           finally:
+            # final durable-state write so a completed-then-reopened
+            # experiment (raised max_trial_count) resumes the suggester too
+            self._persist_suggester(exp, suggester)
             # suggester teardown (remote services evict their per-experiment
             # state — the analog of deleting the algorithm Deployment,
             # ``suggestion_controller.go:132-143``); best-effort
@@ -340,6 +389,42 @@ class Orchestrator:
         TrialCondition.KILLED: obs.trials_killed,
         TrialCondition.METRICS_UNAVAILABLE: obs.trials_metrics_unavailable,
     }
+
+    def _backfill_store(self, exp: Experiment) -> None:
+        """A restarted process starts with an empty in-memory observation
+        store while the journal holds each trial's reduced observation; the
+        median early stopper reads per-trial logs from the store
+        (``earlystop/medianstop.py``), so seed completed trials' reduced
+        metrics back as single points.  An approximation of the lost series
+        (the reduced value stands in for the first ``start_step`` points) —
+        durable store backends (sqlite/native) that still hold the real
+        series are left untouched."""
+        import math as _math
+
+        for t in exp.trials.values():
+            if t.observation is None or not t.condition.is_terminal():
+                continue
+            if self.store.get(t.name):
+                continue
+            for m in t.observation.metrics:
+                if not _math.isnan(m.value):
+                    self.store.report_point(t.name, m.name, m.value)
+
+    def _persist_suggester(self, exp: Experiment, suggester) -> None:
+        """Journal durable suggester state (ENAS pytree, PBT queue) for
+        restart resume — the FromVolume PVC analog.  Never-policy
+        experiments skip it; best-effort like the status journal."""
+        if exp.spec.resume_policy is ResumePolicy.NEVER:
+            return
+        try:
+            from katib_tpu.orchestrator.resume import save_suggester_state
+
+            save_suggester_state(suggester, self.workdir, exp.name)
+        except Exception:
+            # best-effort like the status journal: an unpicklable custom
+            # state_dict (TypeError, not just PicklingError) must never mask
+            # the experiment result from run()'s finally block
+            pass
 
     def _publish(self, exp: Experiment) -> None:
         """Journal status for CLI/UI views (``status.json`` per experiment);

@@ -1,0 +1,214 @@
+"""Durable experiment resume — reconstruct an :class:`Experiment` from the
+status journal so a killed orchestrator process can pick up where it left
+off.
+
+The reference survives controller restarts because all state lives in CRs on
+the API server plus the suggestion PVC (``suggestion_controller.go:181-193``
+``FromVolume``; ``experiment_controller.go:187-206`` re-open on raised
+``maxTrialCount``).  Here the equivalents are:
+
+- trial history + optimal + mutable ``algorithm_settings`` (Hyperband's
+  state-in-CR round trip) — journaled to ``<workdir>/<exp>/status.json`` on
+  every trial completion (``status.py``), read back by
+  :func:`experiment_from_dict`;
+- in-memory suggester state (ENAS controller pytree, PBT job queue) —
+  pickled to ``<workdir>/<exp>/suggester_state.pkl`` by the orchestrator
+  (the PVC analog), reloaded through the suggester's
+  ``load_state_dict`` hook.
+
+Trials that were still running when the process died are re-materialized
+with their original name/assignments/checkpoint dir and resubmitted — the
+analog of the job controller recreating pods for a trial CR that still
+exists (reference trials keep running across controller restarts; ours
+cannot, so they are re-run).  Their Orbax checkpoint dir survives, so a
+``train_fn`` that restores from its last step resumes mid-trial.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import pickle
+import tempfile
+
+from katib_tpu.core.types import (
+    Experiment,
+    ExperimentCondition,
+    ExperimentSpec,
+    Metric,
+    Observation,
+    OptimalTrial,
+    ParameterAssignment,
+    Trial,
+    TrialCondition,
+    TrialSpec,
+)
+
+SUGGESTER_STATE_FILE = "suggester_state.pkl"
+
+
+def _coerce_assignments(spec: ExperimentSpec, raw: dict) -> list[ParameterAssignment]:
+    """Journal values are JSON scalars; cast back through the parameter spec
+    where the name matches (NAS/PBT string parameters pass through as-is)."""
+    out = []
+    by_name = {p.name: p for p in spec.parameters}
+    for name, value in raw.items():
+        p = by_name.get(name)
+        if p is not None:
+            try:
+                value = p.cast(value)
+            except (TypeError, ValueError):
+                pass
+        out.append(ParameterAssignment(name=name, value=value))
+    return out
+
+
+def _observation_from_list(metrics: list[dict] | None) -> Observation | None:
+    if metrics is None:
+        return None
+    nan = float("nan")
+
+    def f(v):
+        return nan if v is None else float(v)
+
+    return Observation(
+        metrics=[
+            Metric(
+                name=m["name"],
+                value=f(m.get("value")),
+                min=f(m.get("min", nan)),
+                max=f(m.get("max", nan)),
+                latest=f(m.get("latest", nan)),
+            )
+            for m in metrics
+        ]
+    )
+
+
+def trial_from_dict(spec: ExperimentSpec, data: dict) -> Trial:
+    """Rebuild one trial.  The journal does not persist callables or
+    early-stopping rules; those come from the experiment spec (rules are
+    re-derived if the trial is resubmitted)."""
+    condition = TrialCondition(data["condition"])
+    resubmit = not condition.is_terminal()
+    return Trial(
+        name=data["name"],
+        experiment_name=spec.name,
+        spec=TrialSpec(
+            assignments=_coerce_assignments(spec, data.get("assignments", {})),
+            labels=dict(data.get("labels", {})),
+            train_fn=spec.train_fn,
+            command=list(spec.command) if spec.command else None,
+            metrics_collector=spec.metrics_collector,
+            retain=spec.retain,
+        ),
+        # non-terminal journal entries become PENDING: run() resubmits them
+        condition=TrialCondition.PENDING if resubmit else condition,
+        observation=_observation_from_list(data.get("observation")),
+        message=data.get("message", "") if not resubmit else "resubmitted after restart",
+        start_time=data.get("start_time") or 0.0,
+        completion_time=data.get("completion_time") or 0.0,
+        checkpoint_dir=data.get("checkpoint_dir"),
+    )
+
+
+def experiment_from_dict(spec: ExperimentSpec, status: dict) -> Experiment:
+    """Rebuild the :class:`Experiment` a journal dict describes.
+
+    The caller supplies the spec (callables cannot round-trip through JSON);
+    ``status["name"]`` must match ``spec.name``.
+    """
+    if status.get("name") != spec.name:
+        raise ValueError(
+            f"journal is for experiment {status.get('name')!r}, spec is {spec.name!r}"
+        )
+    exp = Experiment(
+        spec=spec,
+        condition=ExperimentCondition(status.get("condition", "Created")),
+        start_time=status.get("start_time") or 0.0,
+        completion_time=status.get("completion_time") or 0.0,
+        message=status.get("message", ""),
+    )
+    if status.get("algorithm_settings"):
+        exp.algorithm_settings = dict(status["algorithm_settings"])
+    for name, tdata in (status.get("trials") or {}).items():
+        exp.trials[name] = trial_from_dict(spec, tdata)
+    exp.update_optimal()
+    # sanity: journal's recorded optimal should agree; recompute wins because
+    # it is derived from the same trial set
+    if exp.optimal is None and status.get("optimal"):
+        o = status["optimal"]
+        v = o.get("objective_value")
+        if v is not None and not math.isnan(float(v)):
+            exp.optimal = OptimalTrial(
+                trial_name=o.get("trial_name", ""),
+                objective_value=float(v),
+                assignments=_coerce_assignments(spec, o.get("assignments", {})),
+                observation=Observation(),
+            )
+    return exp
+
+
+def load_experiment(spec: ExperimentSpec, workdir: str) -> Experiment | None:
+    """Read ``<workdir>/<spec.name>/status.json`` back into an Experiment;
+    None when no journal exists (fresh run)."""
+    from katib_tpu.orchestrator.status import read_status
+
+    status = read_status(workdir, spec.name)
+    if status is None:
+        return None
+    return experiment_from_dict(spec, status)
+
+
+# -- suggester state (the FromVolume PVC analog) ----------------------------
+
+
+def suggester_state_path(workdir: str, experiment_name: str) -> str:
+    return os.path.join(workdir, experiment_name, SUGGESTER_STATE_FILE)
+
+
+def save_suggester_state(suggester, workdir: str, experiment_name: str) -> bool:
+    """Pickle ``suggester.state_dict()`` atomically; no-op (False) for
+    replay-derived suggesters that expose no state hook."""
+    state_fn = getattr(suggester, "state_dict", None)
+    if state_fn is None:
+        return False
+    exp_dir = os.path.join(workdir, experiment_name)
+    os.makedirs(exp_dir, exist_ok=True)
+    path = suggester_state_path(workdir, experiment_name)
+    fd, tmp = tempfile.mkstemp(dir=exp_dir, prefix=".sugg-", suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            pickle.dump(state_fn(), f)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    return True
+
+
+def load_suggester_state(suggester, workdir: str, experiment_name: str) -> bool:
+    """Restore a previously pickled state into the suggester; False when the
+    file or the hook is absent."""
+    load_fn = getattr(suggester, "load_state_dict", None)
+    if load_fn is None:
+        return False
+    path = suggester_state_path(workdir, experiment_name)
+    try:
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        load_fn(state)
+    except Exception:
+        # a truncated/corrupt pickle (crash between replace and flush) or a
+        # state-schema mismatch must not make the experiment un-resumable:
+        # fall back to the replay-derived fresh suggester
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "suggester state at %s unusable; resuming from trial history only",
+            path,
+            exc_info=True,
+        )
+        return False
+    return True

@@ -61,6 +61,10 @@ def experiment_to_dict(exp: Experiment) -> dict:
             "metrics_unavailable": exp.metrics_unavailable_count,
             "running": exp.running_count,
         },
+        # mutable algorithm settings (Hyperband bracket state lives here) —
+        # persisting them is what makes the journal a full resume source
+        # (reference: state-in-CR, ``suggestionclient.go:194-196``)
+        "algorithm_settings": dict(exp.algorithm_settings),
         "optimal": (
             None
             if exp.optimal is None

@@ -227,3 +227,53 @@ class PbtSuggester(Suggester):
         """The runner mounts this as the trial's checkpoint directory (parity
         with the webhook mounting the PBT PVC, ``inject_webhook.go:334-365``)."""
         return self._ckpt_dir(trial_name)
+
+    # -- persistence hooks (orchestrator journals these across restarts;
+    # the reference's PVC held only the checkpoints — its in-memory queue
+    # was lost on service restart, an acknowledged gap) -----------------
+
+    @staticmethod
+    def _job_dict(job: _PbtJob) -> dict:
+        return {
+            "uid": job.uid,
+            "params": dict(job.params),
+            "generation": job.generation,
+            "parent": job.parent,
+            "score": job.score,
+        }
+
+    @staticmethod
+    def _job_from(d: dict) -> _PbtJob:
+        job = _PbtJob(d["uid"], dict(d["params"]), d["generation"], d["parent"])
+        job.score = d["score"]
+        return job
+
+    def state_dict(self) -> dict:
+        return {
+            "rng": self._rng.bit_generator.state,
+            "pending": [self._job_dict(j) for j in self.pending],
+            "running": {k: self._job_dict(j) for k, j in self.running.items()},
+            "completed": {k: self._job_dict(j) for k, j in self.completed.items()},
+            "pool_current": list(self.pool_current),
+            "pool_previous": list(self.pool_previous),
+        }
+
+    def load_state_dict(self, data: dict) -> None:
+        # parse everything BEFORE mutating, so a schema mismatch leaves the
+        # freshly-seeded suggester intact (the caller falls back to it)
+        rng_state = data["rng"]
+        pending = [self._job_from(d) for d in data["pending"]]
+        running = {k: self._job_from(d) for k, d in data["running"].items()}
+        completed = {k: self._job_from(d) for k, d in data["completed"].items()}
+        pool_current = list(data["pool_current"])
+        pool_previous = list(data["pool_previous"])
+        # discard the freshly-seeded boot population (and its just-created
+        # empty checkpoint dirs) in favor of the journaled queue
+        for job in self.pending:
+            shutil.rmtree(self._ckpt_dir(job.uid), ignore_errors=True)
+        self._rng.bit_generator.state = rng_state
+        self.pending = pending
+        self.running = running
+        self.completed = completed
+        self.pool_current = pool_current
+        self.pool_previous = pool_previous
