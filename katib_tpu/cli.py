@@ -247,13 +247,41 @@ def cmd_conformance(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_suggest_server(args: argparse.Namespace) -> int:
+    """Run the suggestion-as-a-service daemon (the reference's per-experiment
+    algorithm Deployment entrypoint, ``cmd/suggestion/*/v1beta1/main.py``).
+    The auth token comes from ``--token`` or ``KATIB_SUGGEST_TOKEN``;
+    unset = open (localhost development)."""
+    from katib_tpu.suggest.service import serve_suggestions
+
+    token = args.token or os.environ.get("KATIB_SUGGEST_TOKEN") or None
+    svc = serve_suggestions(port=args.port, host=args.host, token=token)
+    print(
+        f"katib-tpu suggestion service: http://{args.host}:{svc.port} "
+        f"(auth: {'bearer token' if token else 'open'})",
+        flush=True,
+    )
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        svc.stop()
+    return 0
+
+
 def cmd_ui(args: argparse.Namespace) -> int:
     from katib_tpu.ui import start_ui
 
     cfg = KatibConfig.load(args.config)
     store = cfg.store.make_store()
-    ui = start_ui(args.workdir, store, port=args.port, host=args.host)
-    print(f"katib-tpu dashboard: http://{args.host}:{ui.port}/")
+    token = args.token or os.environ.get("KATIB_UI_TOKEN") or None
+    ui = start_ui(args.workdir, store, port=args.port, host=args.host, token=token)
+    print(
+        f"katib-tpu dashboard: http://{args.host}:{ui.port}/ "
+        f"(writes: {'bearer token' if token else 'open'})"
+    )
     try:
         while True:
             time.sleep(3600)
@@ -320,10 +348,21 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--max-trials", type=int, default=8)
     p.set_defaults(fn=cmd_conformance)
 
+    p = sub.add_parser(
+        "suggest-server", help="run the suggestion-as-a-service daemon"
+    )
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=6789)
+    p.add_argument("--token", default=None, help="bearer token (or KATIB_SUGGEST_TOKEN)")
+    p.set_defaults(fn=cmd_suggest_server)
+
     p = sub.add_parser("ui", help="serve the REST API + dashboard")
     p.add_argument("--workdir", default="katib_runs")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8080)
+    p.add_argument(
+        "--token", default=None, help="bearer token for write endpoints (or KATIB_UI_TOKEN)"
+    )
     p.set_defaults(fn=cmd_ui)
 
     p = sub.add_parser("doctor", help="environment report")

@@ -525,6 +525,14 @@ class TrialSpec:
     metrics_collector: MetricsCollectorSpec = field(default_factory=MetricsCollectorSpec)
     # retain trial artifacts (checkpoints, logs) after completion
     retain: bool = False
+    # wall-clock deadline for one trial run; None = unbounded (the reference
+    # bounds every e2e experiment at 40 min, ``run-e2e-experiment.py:11`` —
+    # here the bound is enforced per trial so a hung trial can't pin a slot)
+    max_runtime_seconds: float | None = None
+    # bounded re-runs when the trial succeeds but never reported the
+    # objective metric (the reference requeues metrics-not-reported trials,
+    # ``trial_controller.go:182-185``); 0 = classify immediately
+    metrics_retries: int = 0
 
     def params(self) -> dict[str, Any]:
         return assignments_to_dict(self.assignments)
@@ -609,6 +617,10 @@ class ExperimentSpec:
     # Keep trial artifacts (checkpoint steps) after successful completion
     # (reference ``trialTemplate.retain``, ``trial_types.go:57``).
     retain: bool = False
+    # Per-trial wall-clock deadline + metrics-unavailable retry budget,
+    # propagated into every TrialSpec (see TrialSpec for reference parity).
+    max_trial_runtime_seconds: float | None = None
+    metrics_retries: int = 0
 
     def parameter(self, name: str) -> ParameterSpec:
         for p in self.parameters:

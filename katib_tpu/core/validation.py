@@ -88,6 +88,21 @@ def validate_experiment(spec: ExperimentSpec) -> None:
 
     if not spec.name:
         errors.append("experiment name is required")
+    else:
+        # the name becomes a workdir path component (status journal,
+        # checkpoint dirs) and may arrive from a URL/YAML; refuse anything
+        # that escapes the workdir (the reference gets this for free from
+        # K8s DNS-1123 object-name rules)
+        import os as _os
+
+        if (
+            spec.name in (".", "..")
+            or "/" in spec.name
+            or _os.sep in spec.name
+            or (_os.altsep and _os.altsep in spec.name)
+            or "\x00" in spec.name
+        ):
+            errors.append(f"experiment name {spec.name!r} must not contain path separators")
     validate_objective(spec.objective, errors)
 
     if not spec.algorithm or not spec.algorithm.name:

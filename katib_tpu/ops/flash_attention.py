@@ -57,7 +57,10 @@ def _block_sizes(seq_q: int, seq_k: int, block_q: int, block_k: int):
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_k):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_k, shift):
+    """``shift = seq_k - seq_q`` makes the causal mask bottom-right aligned
+    (last query row sees every key), matching ``reference_attention_with_lse``
+    for seq_q != seq_k; both collapse to the usual mask when shift == 0."""
     bq, d = q_ref.shape[-2], q_ref.shape[-1]
     seq_k = k_ref.shape[-2]
     n_kb = seq_k // block_k
@@ -65,8 +68,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_
     q = q_ref[0, 0, :, :].astype(jnp.float32) * sm_scale
 
     if causal:
-        # only k-blocks whose first row index <= last query row participate
-        n_kb_live = jnp.minimum(n_kb, pl.cdiv((qi + 1) * bq, block_k))
+        # only k-blocks starting at or before the last query row's diagonal
+        last_col = jnp.maximum((qi + 1) * bq + shift, 0)
+        n_kb_live = jnp.clip(pl.cdiv(last_col, block_k), 0, n_kb)
     else:
         n_kb_live = n_kb
 
@@ -80,7 +84,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_
         if causal:
             rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
             cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
-            mask = cols <= rows
+            mask = cols <= rows + shift
             s = jnp.where(mask, s, _MASK_VALUE)
         m_new = jnp.maximum(m_acc, jnp.max(s, axis=1))
         # mask the exponent, not just the score: a fully-masked row has
@@ -116,7 +120,8 @@ def _fwd(q, k, v, *, sm_scale, causal, block_q, block_k, interpret):
     grid = (b, h, sq // bq)
     o, lse = pl.pallas_call(
         functools.partial(
-            _fwd_kernel, sm_scale=sm_scale, causal=causal, block_k=bk
+            _fwd_kernel, sm_scale=sm_scale, causal=causal, block_k=bk,
+            shift=sk - sq,
         ),
         grid=grid,
         in_specs=[
@@ -142,7 +147,7 @@ def _fwd(q, k, v, *, sm_scale, causal, block_q, block_k, interpret):
 # ---------------------------------------------------------------------------
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dmd_ref, dq_ref, *, sm_scale, causal, block_k):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dmd_ref, dq_ref, *, sm_scale, causal, block_k, shift):
     """dq for one q-block; streams K/V blocks.  ``dmd`` = rowsum(dO*O) - d_lse,
     folding the logsumexp cotangent into the usual flash "delta" term."""
     bq, d = q_ref.shape[-2], q_ref.shape[-1]
@@ -155,7 +160,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dmd_ref, dq_ref, *, sm_scal
     dmd = dmd_ref[0, 0, :, 0]
 
     n_kb_live = (
-        jnp.minimum(n_kb, pl.cdiv((qi + 1) * bq, block_k)) if causal else n_kb
+        jnp.clip(pl.cdiv(jnp.maximum((qi + 1) * bq + shift, 0), block_k), 0, n_kb)
+        if causal
+        else n_kb
     )
 
     def body(j, dq_acc):
@@ -168,7 +175,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dmd_ref, dq_ref, *, sm_scal
         if causal:
             rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
             cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
-            e = jnp.where(cols <= rows, e, _MASK_VALUE)
+            e = jnp.where(cols <= rows + shift, e, _MASK_VALUE)
         p = jnp.exp(e)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -180,7 +187,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dmd_ref, dq_ref, *, sm_scal
     dq_ref[0, 0, :, :] = dq.astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dmd_ref, dk_ref, dv_ref, *, sm_scale, causal, block_q):
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dmd_ref, dk_ref, dv_ref, *, sm_scale, causal, block_q, shift):
     """dk, dv for one k-block; streams q-blocks (with their dO/lse/delta rows)."""
     bk, d = k_ref.shape[-2], k_ref.shape[-1]
     seq_q = q_ref.shape[-2]
@@ -189,8 +196,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dmd_ref, dk_ref, dv_ref, *
     k = k_ref[0, 0, :, :].astype(jnp.float32)
     v = v_ref[0, 0, :, :].astype(jnp.float32)
 
-    # with causal masking, q-blocks strictly above this k-block contribute 0
-    first_qb = (ki * bk) // block_q if causal else 0
+    # with causal masking, q-blocks strictly above this k-block's diagonal
+    # (bottom-right aligned: row + shift >= col) contribute 0
+    first_qb = jnp.maximum(0, ki * bk - shift) // block_q if causal else 0
 
     def body(i, carry):
         dk_acc, dv_acc = carry
@@ -205,7 +213,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dmd_ref, dk_ref, dv_ref, *
         if causal:
             rows = i * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
             cols = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
-            e = jnp.where(cols <= rows, e, _MASK_VALUE)
+            e = jnp.where(cols <= rows + shift, e, _MASK_VALUE)
         p = jnp.exp(e)
         dv_new = dv_acc + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -235,7 +243,9 @@ def _bwd(q, k, v, o, lse, do, dlse, *, sm_scale, causal, block_q, block_k, inter
     dmd4 = dmd[..., None]
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal, block_k=bk),
+        functools.partial(
+            _dq_kernel, sm_scale=sm_scale, causal=causal, block_k=bk, shift=sk - sq
+        ),
         grid=(b, h, sq // bq),
         in_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda i, j, l: (i, j, l, 0)),
@@ -251,7 +261,9 @@ def _bwd(q, k, v, o, lse, do, dlse, *, sm_scale, causal, block_q, block_k, inter
     )(q, k, v, do, lse4, dmd4)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal, block_q=bq),
+        functools.partial(
+            _dkv_kernel, sm_scale=sm_scale, causal=causal, block_q=bq, shift=sk - sq
+        ),
         grid=(b, h, sk // bk),
         in_specs=[
             pl.BlockSpec((1, 1, sq, d), lambda i, j, l: (i, j, 0, 0)),
@@ -355,12 +367,22 @@ def reference_attention_with_lse(
     s = jnp.einsum(
         "bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
     ) * scale
+    visible = None
     if causal:
         sq, sk = q.shape[2], k.shape[2]
         mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
         s = jnp.where(mask, s, _MASK_VALUE)
-    lse = jax.scipy.special.logsumexp(s, axis=-1)
-    p = jnp.exp(s - lse[..., None])
+        if sq > sk:
+            visible = mask.any(-1)  # rows before the diagonal see no key
+    lse_raw = jax.scipy.special.logsumexp(s, axis=-1)
+    if visible is None:
+        lse = lse_raw
+        p = jnp.exp(s - lse[..., None])
+    else:
+        # fully-masked rows: output 0 and lse=_MASK_VALUE (a no-op when
+        # merged), matching the kernel, instead of uniform-attention junk
+        lse = jnp.where(visible, lse_raw, _MASK_VALUE)
+        p = jnp.exp(s - jnp.where(visible, lse_raw, 0.0)[..., None])
     o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
     return o.astype(q.dtype), lse
 

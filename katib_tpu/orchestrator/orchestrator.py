@@ -308,6 +308,8 @@ class Orchestrator:
                 command=list(exp.spec.command) if exp.spec.command else None,
                 metrics_collector=exp.spec.metrics_collector,
                 retain=exp.spec.retain,
+                max_runtime_seconds=exp.spec.max_trial_runtime_seconds,
+                metrics_retries=exp.spec.metrics_retries,
             ),
             condition=TrialCondition.RUNNING,
             start_time=time.time(),
@@ -343,10 +345,25 @@ class Orchestrator:
         if self.slice_allocator is not None and mesh is None:
             try:
                 with self.slice_allocator.slice_mesh() as trial_mesh:
-                    return self._execute_on(exp, trial, trial_mesh)
+                    return self._execute_with_retry(exp, trial, trial_mesh)
             except Exception:
                 return TrialResult(TrialCondition.FAILED, traceback.format_exc(limit=20))
-        return self._execute_on(exp, trial, mesh)
+        return self._execute_with_retry(exp, trial, mesh)
+
+    def _execute_with_retry(self, exp: Experiment, trial: Trial, mesh):
+        """Bounded re-run when the trial succeeded but never reported the
+        objective metric — the analog of the reference requeueing
+        metrics-not-reported trials after 1s (``trial_controller.go:182-185``).
+        Opt-in via ``metrics_retries`` (default 0: classify immediately)."""
+        result = self._execute_on(exp, trial, mesh)
+        for _ in range(trial.spec.metrics_retries):
+            if result.condition is not TrialCondition.METRICS_UNAVAILABLE:
+                break
+            if self._stop_event.is_set():
+                break
+            time.sleep(1.0)
+            result = self._execute_on(exp, trial, mesh)
+        return result
 
     def _execute_on(self, exp: Experiment, trial: Trial, mesh):
         want_profile = self.config is not None and self.config.init.enable_profiler

@@ -101,6 +101,8 @@ def trial_from_dict(spec: ExperimentSpec, data: dict) -> Trial:
             command=list(spec.command) if spec.command else None,
             metrics_collector=spec.metrics_collector,
             retain=spec.retain,
+            max_runtime_seconds=spec.max_trial_runtime_seconds,
+            metrics_retries=spec.metrics_retries,
         ),
         # non-terminal journal entries become PENDING: run() resubmits them
         condition=TrialCondition.PENDING if resubmit else condition,

@@ -99,17 +99,19 @@ def ring_attention_local(
         lse_new = jnp.logaddexp(lse_acc, lse_i)
         w_acc = jnp.exp(lse_acc - lse_new)[..., None]
         w_i = jnp.exp(lse_i - lse_new)[..., None]
-        o_new = o_acc.astype(jnp.float32) * w_acc + o_i.astype(jnp.float32) * w_i
+        # the accumulator stays float32 across the whole ring: casting back
+        # to bf16 every step would round-trip the output axis_size times
+        o_new = o_acc * w_acc + o_i.astype(jnp.float32) * w_i
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (o_new.astype(q.dtype), lse_new, k_nxt, v_nxt), None
+        return (o_new, lse_new, k_nxt, v_nxt), None
 
-    o0 = jnp.zeros((b, h, s_local, d), q.dtype)
+    o0 = jnp.zeros((b, h, s_local, d), jnp.float32)
     lse0 = jnp.full((b, h, s_local), _MASK_VALUE, jnp.float32)
     (o, _, _, _), _ = jax.lax.scan(
         step, (o0, lse0, k, v), jnp.arange(axis_size)
     )
-    return o
+    return o.astype(q.dtype)
 
 
 def ulysses_attention_local(

@@ -42,6 +42,7 @@ class TrialContext:
         mesh: Any = None,
         labels: Mapping[str, str] | None = None,
         stop_event: Any = None,
+        max_runtime_seconds: float | None = None,
     ):
         self.trial_name = trial_name
         self.params = dict(params)
@@ -53,6 +54,14 @@ class TrialContext:
         self._stop_event = stop_event
         self._step = 0
         self._checkpointer = None
+        # cooperative wall-clock deadline: report()/should_stop() turn False/
+        # True past it and the runner classifies the trial FAILED (a Python
+        # train_fn cannot be preempted; the black-box path SIGTERMs instead)
+        self._deadline = (
+            time.monotonic() + max_runtime_seconds
+            if max_runtime_seconds is not None
+            else None
+        )
 
     # -- metrics -----------------------------------------------------------
 
@@ -83,14 +92,21 @@ class TrialContext:
     def should_stop(self) -> bool:
         """True when an early-stopping rule fired OR the experiment reached a
         terminal state (goal hit / failure budget) and wants trials to wind
-        down."""
+        down OR the trial blew its wall-clock deadline."""
         if self._evaluator is not None and self._evaluator.should_stop():
             return True
+        if self.deadline_exceeded():
+            return True
         return self._stop_event is not None and self._stop_event.is_set()
+
+    def deadline_exceeded(self) -> bool:
+        return self._deadline is not None and time.monotonic() > self._deadline
 
     def raise_if_stopped(self) -> None:
         if self._evaluator is not None and self._evaluator.should_stop():
             raise TrialEarlyStopped(self._evaluator.triggered.describe())
+        if self.deadline_exceeded():
+            raise TrialEarlyStopped("trial max_runtime exceeded")
         if self._stop_event is not None and self._stop_event.is_set():
             raise TrialEarlyStopped("experiment reached terminal state")
 

@@ -99,17 +99,29 @@ def _run_whitebox(
         mesh=mesh,
         labels=trial.spec.labels,
         stop_event=stop_event,
+        max_runtime_seconds=trial.spec.max_runtime_seconds,
     )
+
+    def _deadline_result() -> TrialResult:
+        return TrialResult(
+            TrialCondition.FAILED,
+            f"trial exceeded max_runtime_seconds={trial.spec.max_runtime_seconds}",
+        )
+
     try:
         trial.spec.train_fn(ctx)
     except TrialEarlyStopped as e:
-        if evaluator.triggered is None:
-            return TrialResult(TrialCondition.KILLED, str(e))
-        return TrialResult(TrialCondition.EARLY_STOPPED, str(e))
+        if evaluator.triggered is not None:
+            return TrialResult(TrialCondition.EARLY_STOPPED, str(e))
+        if ctx.deadline_exceeded():
+            return _deadline_result()
+        return TrialResult(TrialCondition.KILLED, str(e))
     except Exception:
         return TrialResult(TrialCondition.FAILED, traceback.format_exc(limit=20))
     if evaluator.should_stop():
         return TrialResult(TrialCondition.EARLY_STOPPED, evaluator.triggered.describe())
+    if ctx.deadline_exceeded():
+        return _deadline_result()
     if stop_event is not None and stop_event.is_set():
         return TrialResult(TrialCondition.KILLED, "experiment reached terminal state")
     return _finalize(trial, store, objective)
@@ -303,6 +315,12 @@ def _run_blackbox(
 
     early_stopped = False
     killed = False
+    deadline_hit = False
+    deadline = (
+        time.monotonic() + trial.spec.max_runtime_seconds
+        if trial.spec.max_runtime_seconds is not None
+        else None
+    )
     terminate_at: float | None = None
     while True:
         polled = parse(source.poll())
@@ -314,7 +332,11 @@ def _run_blackbox(
                 early_stopped = True
         if stop_event is not None and stop_event.is_set():
             killed = True
-        if (early_stopped or killed) and terminate_at is None:
+        if deadline is not None and time.monotonic() > deadline:
+            # per-trial wall-clock bound: SIGTERM (then SIGKILL below) the
+            # hung trial instead of pinning an orchestrator slot forever
+            deadline_hit = True
+        if (early_stopped or killed or deadline_hit) and terminate_at is None:
             proc.terminate()
             terminate_at = time.monotonic()
         if terminate_at is not None and time.monotonic() - terminate_at > 10.0:
@@ -343,6 +365,11 @@ def _run_blackbox(
 
     if early_stopped:
         return TrialResult(TrialCondition.EARLY_STOPPED, evaluator.triggered.describe())
+    if deadline_hit:
+        return TrialResult(
+            TrialCondition.FAILED,
+            f"trial exceeded max_runtime_seconds={trial.spec.max_runtime_seconds}",
+        )
     if killed:
         return TrialResult(TrialCondition.KILLED, "experiment reached terminal state")
     if rc != 0:

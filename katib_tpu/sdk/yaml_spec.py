@@ -115,6 +115,10 @@ def _parse_collector(raw: Mapping[str, Any] | None) -> MetricsCollectorSpec:
     # fileSystemPath: {path, kind}, httpGet: {port, path}}}; flat shape:
     # {kind, path, filter, port, scrapeInterval}
     kind_raw = (raw.get("collector") or {}).get("kind", raw.get("kind", "StdOut"))
+    # the reference CRD spells this kind "PrometheusMetric"
+    # (``common_types.go:216``); accept it so upstream YAMLs round-trip
+    if kind_raw == "PrometheusMetric":
+        kind_raw = "Prometheus"
     try:
         kind = MetricsCollectorKind(kind_raw)
     except ValueError as e:
@@ -225,6 +229,12 @@ def experiment_spec_from_dict(data: Mapping[str, Any]) -> ExperimentSpec:
         command=[str(c) for c in command] if command else None,
         nas_config=_parse_nas_config(spec.get("nasConfig")),
         retain=bool(spec.get("retain", template.get("retain", False))),
+        max_trial_runtime_seconds=(
+            float(spec["maxTrialRuntimeSeconds"])
+            if spec.get("maxTrialRuntimeSeconds") is not None
+            else None
+        ),
+        metrics_retries=int(spec.get("metricsRetries", 0)),
     )
 
 

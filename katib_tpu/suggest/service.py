@@ -221,6 +221,11 @@ class _Entry:
         # (TPE store / PBT queue / ENAS controller) are not thread-safe, and
         # ThreadingHTTPServer handles each POST on its own thread
         self.lock = threading.Lock()
+        # idempotency: a retried POST whose first response was lost must not
+        # advance stateful suggesters (grid/sobol/hyperband) a second time —
+        # the last request id replays its stored reply instead
+        self.last_request_id: str | None = None
+        self.last_response: tuple[int, dict] | None = None
 
 
 class SuggestionService:
@@ -281,23 +286,45 @@ class SuggestionService:
             exp.algorithm_settings = {
                 str(k): str(v) for k, v in payload["settings"].items()
             }
-        try:
-            with entry.lock:
+        request_id = payload.get("request_id")
+        with entry.lock:
+            if (
+                request_id is not None
+                and request_id == entry.last_request_id
+                and entry.last_response is not None
+            ):
+                # retried delivery of a request already applied: replay the
+                # stored reply, do not advance suggester state again
+                return entry.last_response
+            try:
                 proposals = entry.suggester.get_suggestions(exp, count)
-        except SuggestionsNotReady as e:
-            return 409, {"error": str(e), "code": "not_ready"}
-        except SearchExhausted as e:
-            return 410, {"error": str(e), "code": "exhausted"}
-        except SuggesterError as e:
-            return 400, {"error": str(e)}
-        return 200, {
-            "suggestions": [proposal_to_wire(p) for p in proposals],
-            "algorithm_settings": dict(exp.algorithm_settings),
-        }
+            except SuggestionsNotReady as e:
+                return 409, {"error": str(e), "code": "not_ready"}
+            except SearchExhausted as e:
+                return 410, {"error": str(e), "code": "exhausted"}
+            except SuggesterError as e:
+                return 400, {"error": str(e)}
+            response = (
+                200,
+                {
+                    "suggestions": [proposal_to_wire(p) for p in proposals],
+                    "algorithm_settings": dict(exp.algorithm_settings),
+                },
+            )
+            if request_id is not None:
+                entry.last_request_id = request_id
+                entry.last_response = response
+            return response
 
     # -- lifecycle -----------------------------------------------------------
 
-    def serve(self, port: int = 0, host: str = "127.0.0.1") -> "RunningService":
+    def serve(
+        self, port: int = 0, host: str = "127.0.0.1", token: str | None = None
+    ) -> "RunningService":
+        """``token`` enables shared-token auth: every API request must carry
+        ``Authorization: Bearer <token>`` (the TPU-native stand-in for the
+        reference's RBAC-gated service account, ``suggestion_controller.go:
+        209-224``; ``/healthz`` stays open like a readiness probe)."""
         svc = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -309,6 +336,11 @@ class SuggestionService:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _authorized(self) -> bool:
+                from katib_tpu.utils.http import bearer_authorized
+
+                return bearer_authorized(self.headers, token)
+
             def do_GET(self):  # noqa: N802
                 if self.path == "/healthz":
                     self._reply(200, {"status": "serving"})
@@ -316,9 +348,13 @@ class SuggestionService:
                     self._reply(404, {"error": "not found"})
 
             def do_POST(self):  # noqa: N802
+                if not self._authorized():
+                    self._reply(401, {"error": "missing or bad bearer token"})
+                    return
+                from katib_tpu.utils.http import read_json_body
+
                 try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    payload = read_json_body(self)
                 except (ValueError, OSError) as e:
                     self._reply(400, {"error": f"bad payload: {e}"})
                     return
@@ -330,6 +366,9 @@ class SuggestionService:
                     self._reply(404, {"error": "not found"})
 
             def do_DELETE(self):  # noqa: N802
+                if not self._authorized():
+                    self._reply(401, {"error": "missing or bad bearer token"})
+                    return
                 prefix = "/api/v1/experiment/"
                 if self.path.startswith(prefix):
                     self._reply(*svc.forget(self.path[len(prefix):]))
@@ -359,8 +398,101 @@ class RunningService:
         self._server.server_close()
 
 
-def serve_suggestions(port: int = 0, host: str = "127.0.0.1") -> RunningService:
-    return SuggestionService().serve(port=port, host=host)
+def serve_suggestions(
+    port: int = 0, host: str = "127.0.0.1", token: str | None = None
+) -> RunningService:
+    return SuggestionService().serve(port=port, host=host, token=token)
+
+
+# ---------------------------------------------------------------------------
+# composer: per-experiment suggester process lifecycle
+# ---------------------------------------------------------------------------
+
+
+class LocalSuggesterProcess:
+    """Spawn → readiness-gate → tear down a suggester service subprocess;
+    the in-process analog of the reference composer building the algorithm
+    Deployment + Service and waiting for availability
+    (``composer/composer.go:72-296``, ``suggestion_controller.go:229-238``).
+
+    A fresh auth token is generated per process and passed via environment
+    (never argv, which is world-readable in /proc)."""
+
+    def __init__(self, readiness_timeout: float = 60.0):
+        import secrets
+        import socket
+        import subprocess
+        import sys
+
+        self.token = secrets.token_hex(16)
+        # bind-then-release to pick a free port for the child; the tiny race
+        # window is acceptable for a localhost helper process
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            self.port = s.getsockname()[1]
+        self.endpoint = f"http://127.0.0.1:{self.port}"
+        import os as _os
+
+        env = dict(_os.environ)
+        env["KATIB_SUGGEST_TOKEN"] = self.token
+        # the suggester service runs algorithm math on CPU; keep the child
+        # off the TPU so it never contends for the chip grant
+        env["JAX_PLATFORMS"] = "cpu"
+        # the child must import katib_tpu regardless of the caller's cwd
+        # (callers often sys.path-hack rather than install the package)
+        import katib_tpu as _pkg
+
+        pkg_root = _os.path.dirname(_os.path.dirname(_os.path.abspath(_pkg.__file__)))
+        env["PYTHONPATH"] = (
+            pkg_root + _os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH")
+            else pkg_root
+        )
+        self._proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "katib_tpu",
+                "suggest-server",
+                "--host",
+                "127.0.0.1",
+                "--port",
+                str(self.port),
+            ],
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.STDOUT,
+        )
+        self._wait_healthy(readiness_timeout)
+
+    def _wait_healthy(self, timeout: float) -> None:
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        last: Exception | None = None
+        while _time.monotonic() < deadline:
+            if self._proc.poll() is not None:
+                raise RuntimeError(
+                    f"suggester process exited rc={self._proc.returncode} before ready"
+                )
+            try:
+                with urllib.request.urlopen(f"{self.endpoint}/healthz", timeout=2) as r:
+                    if r.status == 200:
+                        return
+            except OSError as e:
+                last = e
+            _time.sleep(0.1)
+        self.stop()
+        raise RuntimeError(f"suggester service never became healthy: {last}")
+
+    def stop(self) -> None:
+        if self._proc.poll() is None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=10)
+            except Exception:
+                self._proc.kill()
+                self._proc.wait(timeout=10)
 
 
 # ---------------------------------------------------------------------------
@@ -395,7 +527,22 @@ class RemoteSuggester(Suggester):
 
     def __init__(self, spec: ExperimentSpec):
         super().__init__(spec)
-        self.endpoint = spec.algorithm.setting("endpoint").rstrip("/")
+        endpoint = spec.algorithm.setting("endpoint")
+        self._local: LocalSuggesterProcess | None = None
+        if endpoint == "auto":
+            # composer mode: spawn a private suggester service subprocess,
+            # readiness-gated; torn down in close() with the experiment
+            # (``composer.go:72-296`` deploy + ``:132-143`` teardown)
+            self._local = LocalSuggesterProcess()
+            endpoint = self._local.endpoint
+            self.token: str | None = self._local.token
+        else:
+            import os as _os
+
+            self.token = spec.algorithm.setting("token") or _os.environ.get(
+                "KATIB_SUGGEST_TOKEN"
+            )
+        self.endpoint = endpoint.rstrip("/")
         self.algorithm = spec.algorithm.setting("algorithm")
 
     def _wire_spec(self) -> dict:
@@ -403,16 +550,21 @@ class RemoteSuggester(Suggester):
         settings = {
             k: v
             for k, v in wire["algorithm"]["settings"].items()
-            if k not in ("endpoint", "algorithm")
+            if k not in ("endpoint", "algorithm", "token")
         }
         wire["algorithm"] = {"name": self.algorithm, "settings": settings}
         return wire
 
+    def _headers(self) -> dict:
+        headers = {"Content-Type": "application/json"}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
+        return headers
+
     def _post(self, path: str, payload: dict) -> tuple[int, dict]:
         data = json.dumps(payload).encode()
         req = urllib.request.Request(
-            f"{self.endpoint}{path}", data=data,
-            headers={"Content-Type": "application/json"},
+            f"{self.endpoint}{path}", data=data, headers=self._headers()
         )
         def safe_json(raw: bytes) -> dict:
             # a proxy's HTML error page must not escape as JSONDecodeError
@@ -439,15 +591,20 @@ class RemoteSuggester(Suggester):
         raise SuggestionsNotReady(f"suggestion service unreachable: {last}")
 
     def get_suggestions(self, experiment: Experiment, count: int):
+        import uuid
+
         payload = {
             "spec": self._wire_spec(),
             "trials": [trial_to_wire(t) for t in experiment.trials.values()],
             "settings": {
                 k: v
                 for k, v in experiment.algorithm_settings.items()
-                if k not in ("endpoint", "algorithm")
+                if k not in ("endpoint", "algorithm", "token")
             },
             "count": count,
+            # constant across transport retries: the service replays its
+            # stored reply instead of advancing stateful suggesters twice
+            "request_id": uuid.uuid4().hex,
         }
         status, reply = self._post("/api/v1/suggestions", payload)
         if status == 409:
@@ -468,9 +625,13 @@ class RemoteSuggester(Suggester):
         import http.client
 
         req = urllib.request.Request(
-            f"{self.endpoint}/api/v1/experiment/{self.spec.name}", method="DELETE"
+            f"{self.endpoint}/api/v1/experiment/{self.spec.name}",
+            method="DELETE",
+            headers=self._headers(),
         )
         try:
             urllib.request.urlopen(req, timeout=10).close()
         except (OSError, urllib.error.HTTPError, http.client.HTTPException):
             pass
+        if self._local is not None:
+            self._local.stop()

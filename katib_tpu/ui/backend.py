@@ -16,13 +16,24 @@ Endpoints (JSON unless noted):
 - ``GET /api/trial/<name>/metrics``            raw metric log from the store
 - ``GET /api/experiment/<name>/nas``           NAS graph (nodes/edges) for the
                                                best (or named ``?trial=``) trial
-- ``GET /``                                    dashboard (text/html)
+- ``POST /api/experiments``                    create + run a black-box experiment
+                                               (body: the YAML spec as JSON, or
+                                               ``{"yaml": "<text>"}``) — parity with
+                                               ``backend.go:86`` CreateExperiment
+- ``POST /api/experiment/<name>/stop``         wind the running experiment down
+- ``DELETE /api/experiment/<name>``            remove a finished experiment's journal
+                                               (``backend.go:138`` DeleteExperiment)
+- ``GET /``                                    dashboard (text/html, incl. create form)
+
+Write endpoints optionally require ``Authorization: Bearer <token>``
+(``token=`` / ``KATIB_UI_TOKEN``); reads stay open like the reference UI.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import shutil
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
@@ -128,11 +139,106 @@ def nas_graph_for_trial(trial: dict) -> dict | None:
 
 
 class UiServer:
-    """Read-only dashboard server over a workdir + observation store."""
+    """Dashboard server over a workdir + observation store.  Reads come from
+    the status journal; writes (create/stop/delete) own orchestrator runs in
+    background threads — the collapse of the reference UI's CRD CRUD proxy
+    (``backend.go:86-181``) now that there is no API server between UI and
+    controller."""
 
-    def __init__(self, workdir: str, store: ObservationStore | None = None):
+    def __init__(
+        self,
+        workdir: str,
+        store: ObservationStore | None = None,
+        token: str | None = None,
+    ):
         self.workdir = workdir
         self.store = store
+        # empty string (e.g. `KATIB_UI_TOKEN=` in a shell) means "no auth",
+        # not "require the empty token"
+        self.token = (token or os.environ.get("KATIB_UI_TOKEN")) or None
+        self._runs: dict[str, object] = {}  # name -> Orchestrator
+        self._threads: dict[str, threading.Thread] = {}
+        self._run_lock = threading.Lock()
+
+    # -- write path ----------------------------------------------------------
+
+    def _parse_spec(self, payload: dict):
+        from katib_tpu.sdk.yaml_spec import SpecError, experiment_spec_from_dict
+
+        if "yaml" in payload:
+            import yaml as _yaml
+
+            try:
+                payload = _yaml.safe_load(payload["yaml"])
+            except _yaml.YAMLError as e:
+                raise SpecError(f"bad YAML: {e}") from e
+            if not isinstance(payload, dict):
+                raise SpecError("YAML body must be a mapping")
+        return experiment_spec_from_dict(payload)
+
+    def create(self, payload: dict):
+        from katib_tpu.core.validation import ValidationError, validate_experiment
+        from katib_tpu.orchestrator import Orchestrator
+        from katib_tpu.sdk.yaml_spec import SpecError
+
+        try:
+            spec = self._parse_spec(payload)
+            # full admission check HERE so a bad spec (incl. a path-escaping
+            # name) is a 400 at the API, not a silent background failure
+            validate_experiment(spec)
+        except (ValidationError, SpecError, KeyError, TypeError, ValueError) as e:
+            return 400, {"error": str(e)}
+        if spec.command is None:
+            # a callable cannot arrive over HTTP; UI-created experiments are
+            # black-box by construction (same restriction as the reference:
+            # trials are container commands)
+            return 400, {"error": "experiment must define trialTemplate.command"}
+        with self._run_lock:
+            running = self._threads.get(spec.name)
+            if running is not None and running.is_alive():
+                return 409, {"error": f"experiment {spec.name!r} is already running"}
+            if read_status(self.workdir, spec.name) is not None:
+                return 409, {"error": f"experiment {spec.name!r} already exists"}
+            orch = Orchestrator(workdir=self.workdir, store=self.store)
+            thread = threading.Thread(
+                target=self._run_background,
+                args=(orch, spec),
+                name=f"ui-run-{spec.name}",
+                daemon=True,
+            )
+            self._runs[spec.name] = orch
+            self._threads[spec.name] = thread
+            thread.start()
+        return 201, {"ok": True, "name": spec.name}
+
+    @staticmethod
+    def _run_background(orch, spec) -> None:
+        try:
+            orch.run(spec)
+        except Exception:
+            pass  # terminal state + message are journaled by the orchestrator
+
+    def stop(self, name: str):
+        with self._run_lock:
+            orch = self._runs.get(name)
+            thread = self._threads.get(name)
+        if orch is None or thread is None or not thread.is_alive():
+            return 409, {"error": f"experiment {name!r} is not running here"}
+        orch.stop()
+        return 202, {"ok": True, "stopping": name}
+
+    def delete(self, name: str):
+        status = read_status(self.workdir, name)
+        if status is None:
+            return 404, {"error": f"experiment {name!r} not found"}
+        with self._run_lock:
+            thread = self._threads.get(name)
+            if thread is not None and thread.is_alive():
+                return 409, {"error": f"experiment {name!r} is still running; stop it first"}
+            self._runs.pop(name, None)
+            self._threads.pop(name, None)
+        shutil.rmtree(os.path.join(self.workdir, name), ignore_errors=True)
+        return 200, {"ok": True, "deleted": name}
 
     # route handlers return (status, payload) with payload JSON-serializable
 
@@ -202,15 +308,27 @@ class UiServer:
             return self.trial_metrics(parts[2])
         return 404, {"error": "not found"}
 
+    def route_post(self, path: str, payload: dict):
+        parts = [p for p in path.split("/") if p]
+        if parts == ["api", "experiments"]:
+            return self.create(payload)
+        if len(parts) == 4 and parts[:2] == ["api", "experiment"] and parts[3] == "stop":
+            return self.stop(parts[2])
+        return 404, {"error": "not found"}
+
+    def route_delete(self, path: str):
+        parts = [p for p in path.split("/") if p]
+        if len(parts) == 3 and parts[:2] == ["api", "experiment"]:
+            return self.delete(parts[2])
+        return 404, {"error": "not found"}
+
     # -- server lifecycle ----------------------------------------------------
 
     def serve(self, port: int = 0, host: str = "127.0.0.1") -> "RunningUi":
         ui = self
 
         class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802 (http.server API)
-                parsed = urlparse(self.path)
-                status, payload = ui.route(parsed.path, parse_qs(parsed.query))
+            def _send(self, status, payload) -> None:
                 if status == "html":
                     body = payload.encode()
                     self.send_response(200)
@@ -222,6 +340,31 @@ class UiServer:
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 (http.server API)
+                parsed = urlparse(self.path)
+                self._send(*ui.route(parsed.path, parse_qs(parsed.query)))
+
+            def do_POST(self):  # noqa: N802
+                from katib_tpu.utils.http import bearer_authorized, read_json_body
+
+                if not bearer_authorized(self.headers, ui.token):
+                    self._send(401, {"error": "missing or bad bearer token"})
+                    return
+                try:
+                    payload = read_json_body(self)
+                except (ValueError, OSError) as e:
+                    self._send(400, {"error": f"bad payload: {e}"})
+                    return
+                self._send(*ui.route_post(urlparse(self.path).path, payload))
+
+            def do_DELETE(self):  # noqa: N802
+                from katib_tpu.utils.http import bearer_authorized
+
+                if not bearer_authorized(self.headers, ui.token):
+                    self._send(401, {"error": "missing or bad bearer token"})
+                    return
+                self._send(*ui.route_delete(urlparse(self.path).path))
 
             def log_message(self, *args):
                 pass
@@ -248,9 +391,9 @@ class RunningUi:
 
 def start_ui(
     workdir: str, store: ObservationStore | None = None, port: int = 0,
-    host: str = "127.0.0.1",
+    host: str = "127.0.0.1", token: str | None = None,
 ) -> RunningUi:
-    return UiServer(workdir, store).serve(port=port, host=host)
+    return UiServer(workdir, store, token=token).serve(port=port, host=host)
 
 
 # -- the dashboard (single file, no build step) ------------------------------
@@ -270,26 +413,40 @@ tr.sel{background:#eef4ff} tbody tr{cursor:pointer}
 #detail{margin-top:1rem} pre{background:#272822;color:#f8f8f2;padding:1rem;overflow:auto;font-size:.8rem}
 </style></head><body>
 <h1>katib-tpu experiments</h1>
+<details id="create"><summary>create experiment</summary>
+<p>Paste a Katib-style experiment YAML (black-box <code>trialTemplate.command</code> trials).</p>
+<textarea id="yaml" rows="14" style="width:100%;font-family:monospace"></textarea><br>
+<input id="token" placeholder="bearer token (if required)" style="width:18rem">
+<button id="submit">run</button> <span id="createmsg"></span></details>
 <table id="exps"><thead><tr><th>name</th><th>status</th><th>algorithm</th>
-<th>objective</th><th>trials</th><th>best</th></tr></thead><tbody></tbody></table>
+<th>objective</th><th>trials</th><th>best</th><th></th></tr></thead><tbody></tbody></table>
 <div id="detail"></div>
 <script>
 const esc=s=>String(s??"").replace(/[&<>"]/g,c=>({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;"}[c]));
 const badge=c=>`<span class="badge ${esc(c)}">${esc(c)}</span>`;
 async function j(u){const r=await fetch(u);return r.json()}
+function hdrs(){const t=document.getElementById('token').value;
+  return t?{'Content-Type':'application/json','Authorization':'Bearer '+t}:{'Content-Type':'application/json'}}
+async function act(u,method,body){const r=await fetch(u,{method,headers:hdrs(),body});
+  const p=await r.json();document.getElementById('createmsg').textContent=p.error||'ok';refresh();return p}
 let current=null;
 async function refresh(){
   const exps=await j('/api/experiments');
   document.querySelector('#exps tbody').innerHTML=exps.map(e=>{
-    const c=e.counts||{},o=e.optimal;
+    const c=e.counts||{},o=e.optimal,n=encodeURIComponent(e.name);
+    const running=e.condition==='Running'||e.condition==='Restarting';
+    const btn=running?`<button onclick="event.stopPropagation();act('/api/experiment/${n}/stop','POST')">stop</button>`
+      :`<button onclick="event.stopPropagation();act('/api/experiment/${n}','DELETE')">delete</button>`;
     return `<tr data-n="${esc(e.name)}" class="${e.name===current?'sel':''}">`+
       `<td>${esc(e.name)}</td><td>${badge(e.condition)}</td><td>${esc(e.algorithm)}</td>`+
       `<td>${esc(e.objective_metric)}</td><td>${c.succeeded??0}/${c.trials??0}</td>`+
-      `<td>${o?esc(o.objective_value?.toFixed?.(5)??o.objective_value):"—"}</td></tr>`;
+      `<td>${o?esc(o.objective_value?.toFixed?.(5)??o.objective_value):"—"}</td><td>${btn}</td></tr>`;
   }).join('');
   document.querySelectorAll('#exps tbody tr').forEach(tr=>tr.onclick=()=>show(tr.dataset.n));
   if(current)show(current,false);
 }
+document.getElementById('submit').onclick=()=>
+  act('/api/experiments','POST',JSON.stringify({yaml:document.getElementById('yaml').value}));
 async function show(name,re=true){
   current=name;
   const t=await j('/api/experiment/'+encodeURIComponent(name)+'/trials');

@@ -1,0 +1,32 @@
+"""Shared helpers for the framework's ``http.server``-based endpoints (UI
+backend, suggestion service) — one implementation of bearer-token auth and
+JSON body reading so the two servers cannot drift."""
+
+from __future__ import annotations
+
+import hmac
+import json
+
+
+def bearer_authorized(headers, token: str | None) -> bool:
+    """Constant-time check of ``Authorization: Bearer <token>``; a ``None``
+    token means the endpoint is open.  Any undecodable/malformed header is
+    an auth failure, never an exception (a 500 would leak whether a token is
+    configured)."""
+    if token is None:
+        return True
+    try:
+        got = headers.get("Authorization", "") or ""
+        return hmac.compare_digest(got.encode("utf-8"), f"Bearer {token}".encode("utf-8"))
+    except (UnicodeError, TypeError):
+        return False
+
+
+def read_json_body(handler) -> dict:
+    """Read and parse the request body of a ``BaseHTTPRequestHandler`` as a
+    JSON object.  Raises ``ValueError`` on anything malformed."""
+    n = int(handler.headers.get("Content-Length", 0))
+    payload = json.loads(handler.rfile.read(n) or b"{}")
+    if not isinstance(payload, dict):
+        raise ValueError("body must be a JSON object")
+    return payload

@@ -1,0 +1,134 @@
+"""32-trial Hyperband sweep e2e on the 8-device virtual mesh (VERDICT r1
+item 4 — the BASELINE v5e-64 scenario demonstrated at CPU scale).
+
+Asserts the reference e2e invariants (``run-e2e-experiment.py:52-60``: best
+objective exists; MaxTrialsReached ⇒ completed == maxTrialCount) plus the
+Hyperband-specific ones: rung promotion via labels, the resource parameter
+raised per rung, and ``SliceAllocator`` leasing disjoint one-device
+sub-meshes to at most ``parallel_trial_count`` concurrent trials.
+
+r_l=16, eta=4 ⇒ brackets s=2 (16@1, 4@4, 1@16), s=1 (6@4, 2@16), s=0 (3@16)
+— exactly 32 trials.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+
+import jax
+
+from katib_tpu.core.types import (
+    AlgorithmSpec,
+    ExperimentCondition,
+    ExperimentSpec,
+    FeasibleSpace,
+    ObjectiveSpec,
+    ObjectiveType,
+    ParameterSpec,
+    ParameterType,
+)
+from katib_tpu.orchestrator import Orchestrator
+from katib_tpu.parallel.distributed import SliceAllocator
+from katib_tpu.suggest.hyperband import I_LABEL, S_LABEL
+
+
+def test_hyperband_32_trial_sweep_with_slice_leasing(tmp_path):
+    concurrency = {"now": 0, "peak": 0}
+    seen_devices: list = []
+    lock = threading.Lock()
+
+    def train(ctx):
+        with lock:
+            concurrency["now"] += 1
+            concurrency["peak"] = max(concurrency["peak"], concurrency["now"])
+            seen_devices.append(tuple(d.id for d in ctx.mesh.devices.flat))
+        try:
+            assert ctx.mesh is not None and ctx.mesh.devices.size == 1
+            lr = float(ctx.params["lr"])
+            epochs = int(float(ctx.params["epochs"]))
+            base = 1.0 - (lr - 0.1) ** 2
+            for epoch in range(epochs):
+                # run the epoch's "compute" on the leased sub-mesh so the
+                # lease is actually exercised on-device
+                with ctx.mesh:
+                    x = jax.numpy.full((4, 4), lr)
+                    val = float(jax.jit(lambda a: (a @ a).sum())(x))
+                assert math.isfinite(val)
+                acc = base * (1.0 - math.exp(-(epoch + 1) / 4.0))
+                if not ctx.report(step=epoch, accuracy=acc):
+                    return
+        finally:
+            with lock:
+                concurrency["now"] -= 1
+
+    spec = ExperimentSpec(
+        name="hyperband-sweep",
+        algorithm=AlgorithmSpec(
+            name="hyperband",
+            settings={"r_l": "16", "resource_name": "epochs", "eta": "4"},
+        ),
+        objective=ObjectiveSpec(
+            type=ObjectiveType.MAXIMIZE, objective_metric_name="accuracy"
+        ),
+        parameters=[
+            ParameterSpec("lr", ParameterType.DOUBLE, FeasibleSpace(min=0.01, max=0.5)),
+            ParameterSpec(
+                "epochs", ParameterType.INT, FeasibleSpace(min=1, max=16)
+            ),
+        ],
+        max_trial_count=32,
+        # hyperband validation needs >= eta^s_max = 16 slots so a full rung
+        # can be in flight; the 8-slice allocator still caps the number of
+        # trials actually on a device at 8
+        parallel_trial_count=16,
+        train_fn=train,
+    )
+    allocator = SliceAllocator(slice_size=1, devices=jax.devices())
+    assert allocator.n_slices == 8
+    exp = Orchestrator(workdir=str(tmp_path), slice_allocator=allocator).run(spec)
+
+    # reference e2e invariants
+    assert exp.condition in (
+        ExperimentCondition.MAX_TRIALS_REACHED,
+        ExperimentCondition.SUCCEEDED,
+    ), exp.message
+    assert exp.optimal is not None
+    assert exp.succeeded_count == 32
+    if exp.condition is ExperimentCondition.MAX_TRIALS_REACHED:
+        assert len(exp.trials) == 32
+
+    # rung structure: every trial labeled; bracket s=2 rung 0 has 16 trials
+    rungs: dict[tuple[str, str], list] = {}
+    for t in exp.trials.values():
+        key = (t.labels[S_LABEL], t.labels[I_LABEL])
+        rungs.setdefault(key, []).append(t)
+    assert len(rungs["2", "0"]) == 16
+    assert len(rungs["2", "1"]) == 4
+    assert len(rungs["2", "2"]) == 1
+    assert len(rungs["1", "0"]) == 6
+    assert len(rungs["1", "1"]) == 2
+    assert len(rungs["0", "0"]) == 3
+
+    # promotion: each promoted trial names a parent in the previous rung,
+    # keeps its lr, and raises the resource parameter eta-fold
+    promoted = [t for t in exp.trials.values() if "hyperband-parent" in t.labels]
+    assert promoted
+    for t in promoted:
+        parent = exp.trials[t.labels["hyperband-parent"]]
+        assert parent.labels[S_LABEL] == t.labels[S_LABEL]
+        assert int(parent.labels[I_LABEL]) == int(t.labels[I_LABEL]) - 1
+        assert t.params()["lr"] == parent.params()["lr"]
+        assert int(float(t.params()["epochs"])) == 4 * int(
+            float(parent.params()["epochs"])
+        )
+    # rung 0 of bracket s=2 ran at the minimum resource, top rung at r_l
+    assert all(int(float(t.params()["epochs"])) == 1 for t in rungs["2", "0"])
+    assert all(int(float(t.params()["epochs"])) == 16 for t in rungs["2", "2"])
+    # more resource helped: the optimum came from a full-resource rung
+    assert int(float(dict((a.name, a.value) for a in exp.optimal.assignments)["epochs"])) >= 4
+
+    # slice leasing: never more than 8 concurrent, every lease a 1-device mesh
+    assert 1 < concurrency["peak"] <= 8
+    assert len(seen_devices) == 32
+    assert all(len(d) == 1 for d in seen_devices)

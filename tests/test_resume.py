@@ -325,3 +325,19 @@ class TestSuggesterStatePersistence:
         assert exp2.condition is ExperimentCondition.MAX_TRIALS_REACHED
         assert exp2.succeeded_count >= 9 - 1  # requeues tolerated
         assert len(exp2.trials) >= 9
+
+
+class TestStatusPathSafety:
+    def test_read_status_rejects_traversal_names(self, tmp_path):
+        import json, os
+        outside = tmp_path / "outside"
+        inside = tmp_path / "runs" / "ok"
+        inside.mkdir(parents=True)
+        (tmp_path / "runs").mkdir(exist_ok=True)
+        outside.mkdir()
+        (outside / "status.json").write_text(json.dumps({"name": "evil"}))
+        (inside / "status.json").write_text(json.dumps({"name": "ok"}))
+        workdir = str(tmp_path / "runs")
+        assert read_status(workdir, "ok") == {"name": "ok"}
+        for bad in ("..", ".", "", "../outside", "a/b", f"..{os.sep}outside"):
+            assert read_status(workdir, bad) is None

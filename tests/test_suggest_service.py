@@ -286,3 +286,112 @@ class TestRemoteSuggesterEndToEnd:
         with pytest.raises(urllib.error.HTTPError) as e:
             urllib.request.urlopen(req)
         assert e.value.code == 404
+
+
+class TestAuthAndIdempotency:
+    def test_token_gates_api_but_not_healthz(self):
+        svc = SuggestionService().serve(token="s3cret")
+        try:
+            base = f"http://127.0.0.1:{svc.port}"
+            with urllib.request.urlopen(f"{base}/healthz") as r:
+                assert r.status == 200
+            req = urllib.request.Request(
+                f"{base}/api/v1/validate",
+                data=json.dumps({"spec": spec_to_wire(_spec())}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req)
+            assert e.value.code == 401
+            req.add_header("Authorization", "Bearer s3cret")
+            with urllib.request.urlopen(req) as r:
+                assert json.loads(r.read())["ok"]
+        finally:
+            svc.stop()
+
+    def test_request_id_replays_not_reapplies(self):
+        """A retried POST with the same request_id must not advance stateful
+        suggester state (ADVICE r1: a lost response + client retry would
+        double-apply ENAS controller training / PBT queue pops)."""
+        from katib_tpu.suggest.base import Suggester, register
+
+        calls = {"n": 0}
+
+        @register("counting-stub")
+        class CountingStub(Suggester):
+            def get_suggestions(self, experiment, count):
+                calls["n"] += 1
+                return [
+                    TrialAssignmentSet(
+                        assignments=[ParameterAssignment("x", float(calls["n"]))]
+                    )
+                ]
+
+        try:
+            svc = SuggestionService()
+            wire = spec_to_wire(
+                _spec(algorithm="counting-stub", name="idem-exp", settings={})
+            )
+            payload = {"spec": wire, "trials": [], "count": 1, "request_id": "rid-1"}
+            s1, r1 = svc.suggestions(payload)
+            s2, r2 = svc.suggestions(payload)  # simulated transport retry
+            assert s1 == s2 == 200
+            assert r1 == r2  # replayed, not re-generated
+            assert calls["n"] == 1  # the suggester ran once
+            payload2 = {"spec": wire, "trials": [], "count": 1, "request_id": "rid-2"}
+            _, r3 = svc.suggestions(payload2)
+            assert calls["n"] == 2  # a fresh id advances state
+            assert r3 != r1
+        finally:
+            from katib_tpu.suggest.base import _REGISTRY
+
+            _REGISTRY.pop("counting-stub", None)
+
+
+class TestComposerLifecycle:
+    def test_auto_spawn_health_gate_teardown(self, tmp_path):
+        """endpoint: auto spawns a private suggest-server subprocess,
+        readiness-gates it, runs the experiment through it, and tears it
+        down with the experiment (composer.go:72-296 parity)."""
+        spec = _spec(
+            algorithm="remote",
+            name="auto-exp",
+            settings={"endpoint": "auto", "algorithm": "tpe"},
+        )
+
+        def train(ctx):
+            ctx.report(step=0, accuracy=1.0 - (float(ctx.params["x"]) - 2.0) ** 2)
+
+        spec.train_fn = train
+        orch = Orchestrator(workdir=str(tmp_path))
+        from katib_tpu.suggest.base import make_suggester
+
+        suggester = make_suggester(spec)
+        try:
+            assert suggester._local is not None
+            proc = suggester._local._proc
+            assert proc.poll() is None  # alive and health-gated
+            exp_probe = __import__("katib_tpu.core.types", fromlist=["Experiment"])
+            proposals = suggester.get_suggestions(
+                exp_probe.Experiment(spec=spec), 2
+            )
+            assert len(proposals) == 2
+        finally:
+            suggester.close(exp_probe.Experiment(spec=spec))
+        assert proc.poll() is not None  # torn down
+
+    def test_orchestrator_e2e_with_auto_endpoint(self, tmp_path):
+        spec = _spec(
+            algorithm="remote",
+            name="auto-e2e",
+            settings={"endpoint": "auto", "algorithm": "random"},
+            max_trial_count=3,
+        )
+
+        def train(ctx):
+            ctx.report(step=0, accuracy=0.5)
+
+        spec.train_fn = train
+        exp = Orchestrator(workdir=str(tmp_path)).run(spec)
+        assert exp.condition is ExperimentCondition.MAX_TRIALS_REACHED
+        assert exp.succeeded_count == 3

@@ -145,3 +145,155 @@ class TestNasGraphs:
 
     def test_no_artifact_returns_none(self, tmp_path):
         assert nas_graph_for_trial({"assignments": {}, "checkpoint_dir": str(tmp_path)}) is None
+
+
+EXP_YAML = """
+metadata:
+  name: {name}
+spec:
+  maxTrialCount: 2
+  parallelTrialCount: 1
+  objective:
+    type: maximize
+    objectiveMetricName: score
+  algorithm:
+    algorithmName: random
+  parameters:
+    - name: x
+      parameterType: double
+      feasibleSpace: {{min: "0.0", max: "1.0"}}
+  trialTemplate:
+    command:
+      - python
+      - -c
+      - "print('score=' + str(float('${{trialParameters.x}}')))"
+"""
+
+
+def _post(port, path, payload, token=None):
+    headers = {"Content-Type": "application/json"}
+    if token:
+        headers["Authorization"] = f"Bearer {token}"
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=json.dumps(payload).encode(),
+        headers=headers,
+    )
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _delete(port, path, token=None):
+    headers = {"Authorization": f"Bearer {token}"} if token else {}
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", method="DELETE", headers=headers
+    )
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+class TestUiWritePath:
+    """POST create / stop + DELETE — parity with ``backend.go:86-181``."""
+
+    def test_create_runs_and_delete(self, tmp_path):
+        import time as _time
+
+        ui = start_ui(str(tmp_path), MemoryObservationStore())
+        try:
+            status, reply = _post(
+                ui.port, "/api/experiments", {"yaml": EXP_YAML.format(name="ui-created")}
+            )
+            assert status == 201 and reply["name"] == "ui-created"
+            deadline = _time.time() + 60
+            while _time.time() < deadline:
+                s, _, body = _get(ui.port, "/api/experiment/ui-created")
+                if s == 200 and json.loads(body)["condition"] == "MaxTrialsReached":
+                    break
+                _time.sleep(0.2)
+            else:
+                raise AssertionError("UI-created experiment never completed")
+            # duplicate name refused while journal exists
+            status, reply = _post(
+                ui.port, "/api/experiments", {"yaml": EXP_YAML.format(name="ui-created")}
+            )
+            assert status == 409
+            status, reply = _delete(ui.port, "/api/experiment/ui-created")
+            assert status == 200
+            s, _, _body = _get_raw_status(ui.port, "/api/experiment/ui-created")
+            assert s == 404
+        finally:
+            ui.stop()
+
+    def test_create_requires_command(self, tmp_path):
+        ui = start_ui(str(tmp_path))
+        try:
+            bad = EXP_YAML.format(name="no-cmd").replace("trialTemplate", "ignored")
+            status, reply = _post(ui.port, "/api/experiments", {"yaml": bad})
+            assert status == 400 and "command" in reply["error"]
+        finally:
+            ui.stop()
+
+    def test_stop_winds_down_running_experiment(self, tmp_path):
+        import time as _time
+
+        slow_yaml = EXP_YAML.format(name="ui-slow").replace(
+            "print('score=' + str(float('${trialParameters.x}')))",
+            "import time; print('score=0.5', flush=True); time.sleep(60)",
+        ).replace("maxTrialCount: 2", "maxTrialCount: 50")
+        ui = start_ui(str(tmp_path))
+        try:
+            status, _ = _post(ui.port, "/api/experiments", {"yaml": slow_yaml})
+            assert status == 201
+            deadline = _time.time() + 30
+            while _time.time() < deadline:
+                s, _, body = _get(ui.port, "/api/experiment/ui-slow")
+                if s == 200:
+                    break
+                _time.sleep(0.2)
+            status, reply = _post(ui.port, "/api/experiment/ui-slow/stop", {})
+            assert status == 202
+            deadline = _time.time() + 60
+            while _time.time() < deadline:
+                s, _, body = _get(ui.port, "/api/experiment/ui-slow")
+                if s == 200 and json.loads(body)["condition"] == "Failed":
+                    break
+                _time.sleep(0.2)
+            else:
+                raise AssertionError("stop did not wind the experiment down")
+            # delete while "running thread" has finished is allowed
+            status, _ = _delete(ui.port, "/api/experiment/ui-slow")
+            assert status == 200
+        finally:
+            ui.stop()
+
+    def test_write_auth_token(self, tmp_path):
+        ui = start_ui(str(tmp_path), token="hunter2")
+        try:
+            status, reply = _post(
+                ui.port, "/api/experiments", {"yaml": EXP_YAML.format(name="authed")}
+            )
+            assert status == 401
+            # reads stay open
+            s, _, _b = _get(ui.port, "/api/experiments")
+            assert s == 200
+            status, reply = _post(
+                ui.port,
+                "/api/experiments",
+                {"yaml": EXP_YAML.format(name="authed")},
+                token="hunter2",
+            )
+            assert status == 201
+        finally:
+            ui.stop()
+
+
+def _get_raw_status(port, path):
+    try:
+        return _get(port, path)
+    except urllib.error.HTTPError as e:
+        return e.code, None, e.read()
