@@ -175,7 +175,10 @@ def _child() -> None:
     img_per_sec = BATCH * TIMED_STEPS / dt
     step_secs = dt / TIMED_STEPS
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
-    peak = PEAK_FLOPS.get((gen, "bf16"), 197e12)
+    # MFU denominator must match the compute dtype: the v5e MXU peaks at
+    # 197 TFLOP/s only in bf16; these f32 tensors get half that
+    dtype_key = "bf16" if x.dtype == jnp.bfloat16 else "f32"
+    peak = PEAK_FLOPS.get((gen, dtype_key), PEAK_FLOPS[("v5e", dtype_key)])
     mfu = (flops_per_step / step_secs) / peak if flops_per_step else 0.0
     print(
         _RESULT_TAG
@@ -186,6 +189,7 @@ def _child() -> None:
                 "unit": "images/sec",
                 "vs_baseline": round(float(img_per_sec) / REFERENCE_IMG_PER_SEC, 3),
                 "mfu": round(mfu, 6),
+                "dtype": dtype_key,
                 "platform": platform,
                 "step_secs": round(step_secs, 4),
                 "flops_per_step": flops_per_step,

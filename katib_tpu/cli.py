@@ -255,9 +255,13 @@ def cmd_suggest_server(args: argparse.Namespace) -> int:
     from katib_tpu.suggest.service import serve_suggestions
 
     token = args.token or os.environ.get("KATIB_SUGGEST_TOKEN") or None
-    svc = serve_suggestions(port=args.port, host=args.host, token=token)
+    ssl_context = _maybe_tls(args)
+    svc = serve_suggestions(
+        port=args.port, host=args.host, token=token, ssl_context=ssl_context
+    )
+    scheme = "https" if ssl_context else "http"
     print(
-        f"katib-tpu suggestion service: http://{args.host}:{svc.port} "
+        f"katib-tpu suggestion service: {scheme}://{args.host}:{svc.port} "
         f"(auth: {'bearer token' if token else 'open'})",
         flush=True,
     )
@@ -271,15 +275,55 @@ def cmd_suggest_server(args: argparse.Namespace) -> int:
     return 0
 
 
+def _maybe_tls(args: argparse.Namespace):
+    """``--cert-dir`` turns a serving command into TLS: the rotator in
+    ``utils.certgen`` (re)generates the self-signed bundle there and the
+    server wraps its socket with it (reference ``certgenerator/generator.go``)."""
+    cert_dir = getattr(args, "cert_dir", None)
+    if not cert_dir:
+        return None
+    import ipaddress
+    import socket
+
+    from katib_tpu.utils.certgen import ensure_certs, server_ssl_context
+
+    host = getattr(args, "host", "127.0.0.1")
+    dns, ips = ["localhost"], ["127.0.0.1"]
+    try:
+        ip = ipaddress.ip_address(host)
+        if ip.is_unspecified:
+            # bound on all interfaces: remote clients will connect via the
+            # machine's real addresses, so the leaf needs those SANs too
+            dns.append(socket.gethostname())
+            try:
+                for addr in socket.gethostbyname_ex(socket.gethostname())[2]:
+                    if addr not in ips:
+                        ips.append(addr)
+            except OSError:
+                pass
+        elif str(ip) != "127.0.0.1":
+            ips.append(str(ip))
+    except ValueError:
+        dns.append(host)
+    return server_ssl_context(
+        ensure_certs(cert_dir, dns_names=tuple(dns), ip_addresses=tuple(ips))
+    )
+
+
 def cmd_ui(args: argparse.Namespace) -> int:
     from katib_tpu.ui import start_ui
 
     cfg = KatibConfig.load(args.config)
     store = cfg.store.make_store()
     token = args.token or os.environ.get("KATIB_UI_TOKEN") or None
-    ui = start_ui(args.workdir, store, port=args.port, host=args.host, token=token)
+    ssl_context = _maybe_tls(args)
+    ui = start_ui(
+        args.workdir, store, port=args.port, host=args.host, token=token,
+        ssl_context=ssl_context,
+    )
+    scheme = "https" if ssl_context else "http"
     print(
-        f"katib-tpu dashboard: http://{args.host}:{ui.port}/ "
+        f"katib-tpu dashboard: {scheme}://{args.host}:{ui.port}/ "
         f"(writes: {'bearer token' if token else 'open'})"
     )
     try:
@@ -354,6 +398,10 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=6789)
     p.add_argument("--token", default=None, help="bearer token (or KATIB_SUGGEST_TOKEN)")
+    p.add_argument(
+        "--cert-dir", default=None,
+        help="serve over TLS with a self-signed bundle rotated in this dir",
+    )
     p.set_defaults(fn=cmd_suggest_server)
 
     p = sub.add_parser("ui", help="serve the REST API + dashboard")
@@ -362,6 +410,10 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--port", type=int, default=8080)
     p.add_argument(
         "--token", default=None, help="bearer token for write endpoints (or KATIB_UI_TOKEN)"
+    )
+    p.add_argument(
+        "--cert-dir", default=None,
+        help="serve over TLS with a self-signed bundle rotated in this dir",
     )
     p.set_defaults(fn=cmd_ui)
 

@@ -93,15 +93,9 @@ def validate_experiment(spec: ExperimentSpec) -> None:
         # checkpoint dirs) and may arrive from a URL/YAML; refuse anything
         # that escapes the workdir (the reference gets this for free from
         # K8s DNS-1123 object-name rules)
-        import os as _os
+        from katib_tpu.utils.names import is_safe_path_component
 
-        if (
-            spec.name in (".", "..")
-            or "/" in spec.name
-            or _os.sep in spec.name
-            or (_os.altsep and _os.altsep in spec.name)
-            or "\x00" in spec.name
-        ):
+        if not is_safe_path_component(spec.name):
             errors.append(f"experiment name {spec.name!r} must not contain path separators")
     validate_objective(spec.objective, errors)
 

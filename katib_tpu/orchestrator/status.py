@@ -97,14 +97,10 @@ def write_status(exp: Experiment, workdir: str) -> str:
 
 def read_status(workdir: str, experiment_name: str) -> dict | None:
     # the name may arrive from a URL (UI backend routes); refuse anything
-    # that could escape the workdir ("..", separators, absolute paths)
-    if (
-        not experiment_name
-        or experiment_name in (".", "..")
-        or "/" in experiment_name
-        or os.sep in experiment_name
-        or (os.altsep and os.altsep in experiment_name)
-    ):
+    # that could escape the workdir ("..", separators, NUL, absolute paths)
+    from katib_tpu.utils.names import is_safe_path_component
+
+    if not is_safe_path_component(experiment_name):
         return None
     path = os.path.join(workdir, experiment_name, STATUS_FILE)
     try:

@@ -154,6 +154,26 @@ def make_suggester(spec: ExperimentSpec) -> Suggester:
     return _REGISTRY[name](spec)
 
 
+def validate_spec(spec: ExperimentSpec) -> None:
+    """Run the registered algorithm's ``validate`` WITHOUT instantiating it.
+    Construction can have side effects (``remote``'s composer mode spawns a
+    service subprocess), which a validate-only caller must never trigger —
+    the analog of ``ValidateAlgorithmSettings`` being a separate RPC from
+    suggestion serving."""
+    import importlib
+
+    from katib_tpu.suggest import algorithms  # noqa: F401
+
+    name = spec.algorithm.name
+    if name not in _REGISTRY and name in algorithms.LAZY_ALGORITHMS:
+        importlib.import_module(algorithms.LAZY_ALGORITHMS[name])
+    if name not in _REGISTRY:
+        raise SuggesterError(
+            f"unknown algorithm {name!r}; registered: {sorted(registered_algorithms())}"
+        )
+    _REGISTRY[name].validate(spec)
+
+
 def registered_algorithms() -> list[str]:
     from katib_tpu.suggest import algorithms  # noqa: F401
 

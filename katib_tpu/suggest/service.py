@@ -248,17 +248,47 @@ class SuggestionService:
     def _fingerprint(wire_spec: dict) -> str:
         return json.dumps(wire_spec, sort_keys=True, default=str)
 
+    @staticmethod
+    def _reject_nested_remote(spec: ExperimentSpec) -> None:
+        # a service serving algorithm "remote" would proxy to yet another
+        # service — and its composer mode would let any network caller spawn
+        # subprocesses on this host.  The reference equally has no
+        # suggestion image that dials a second suggestion service.
+        if spec.algorithm.name == "remote":
+            raise SuggesterError(
+                "algorithm 'remote' cannot be served by a suggestion service; "
+                "point the client at the real algorithm instead"
+            )
+
     def validate(self, payload: dict) -> tuple[int, dict]:
+        from katib_tpu.suggest.base import validate_spec
+
         try:
             spec = self._spec_from_wire(payload)
-            make_suggester(spec)  # constructor runs validate()
+            self._reject_nested_remote(spec)
+            # class-level validate: MUST NOT instantiate (construction can
+            # spawn composer subprocesses the validate path would then leak)
+            validate_spec(spec)
         except (SuggesterError, KeyError, ValueError) as e:
             return 400, {"ok": False, "error": str(e)}
         return 200, {"ok": True}
 
+    @staticmethod
+    def _close_entry(entry: "_Entry | None") -> None:
+        """Best-effort resource teardown for an evicted/forgotten suggester
+        (anything holding processes/sockets exposes ``close``)."""
+        close = getattr(entry.suggester, "close", None) if entry else None
+        if close is None:
+            return
+        try:
+            close(Experiment(spec=entry.suggester.spec))
+        except Exception:
+            pass
+
     def forget(self, name: str) -> tuple[int, dict]:
         with self._lock:
             entry = self._entries.pop(name, None)
+        self._close_entry(entry)
         return (200, {"ok": True}) if entry else (404, {"error": f"unknown experiment {name!r}"})
 
     def suggestions(self, payload: dict) -> tuple[int, dict]:
@@ -268,16 +298,20 @@ class SuggestionService:
         except (KeyError, ValueError) as e:
             return 400, {"error": f"bad request: {e}"}
         fingerprint = self._fingerprint(payload["spec"])
+        evicted: "_Entry | None" = None
         try:
+            self._reject_nested_remote(spec)
             with self._lock:
                 entry = self._entries.get(spec.name)
                 # a re-used experiment name with a different spec gets a
                 # fresh suggester, not the stale one
                 if entry is None or entry.fingerprint != fingerprint:
+                    evicted = entry
                     entry = _Entry(make_suggester(spec), fingerprint)
                     self._entries[spec.name] = entry
         except SuggesterError as e:
             return 400, {"error": str(e)}
+        self._close_entry(evicted)
         exp = Experiment(spec=spec)
         exp.trials = {
             t["name"]: trial_from_wire(t) for t in payload.get("trials") or ()
@@ -319,15 +353,26 @@ class SuggestionService:
     # -- lifecycle -----------------------------------------------------------
 
     def serve(
-        self, port: int = 0, host: str = "127.0.0.1", token: str | None = None
+        self,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        token: str | None = None,
+        ssl_context=None,
     ) -> "RunningService":
         """``token`` enables shared-token auth: every API request must carry
         ``Authorization: Bearer <token>`` (the TPU-native stand-in for the
         reference's RBAC-gated service account, ``suggestion_controller.go:
-        209-224``; ``/healthz`` stays open like a readiness probe)."""
+        209-224``; ``/healthz`` stays open like a readiness probe).
+        ``ssl_context`` (from ``utils.certgen.server_ssl_context``) serves the
+        API over TLS, the analog of the reference webhook's rotated serving
+        cert (``certgenerator/generator.go:37``)."""
         svc = self
 
         class Handler(BaseHTTPRequestHandler):
+            # bounds a stalled peer (incl. a deferred TLS handshake that
+            # never arrives) to this per-connection thread, not the server
+            timeout = 60
+
             def _reply(self, status: int, payload: dict) -> None:
                 body = json.dumps(payload).encode()
                 self.send_response(status)
@@ -347,9 +392,26 @@ class SuggestionService:
                 else:
                     self._reply(404, {"error": "not found"})
 
-            def do_POST(self):  # noqa: N802
+            def _write_guards(self) -> bool:
+                """CSRF + DNS-rebinding guards, mirroring ui/backend.py."""
+                from katib_tpu.utils.http import json_content_type, local_host_allowed
+
+                if self.command == "POST" and not json_content_type(self.headers):
+                    self._reply(415, {"error": "Content-Type must be application/json"})
+                    return False
+                if token is None and not local_host_allowed(self.headers):
+                    self._reply(403, {
+                        "error": "Host not recognized (DNS-rebinding guard); "
+                        "set a bearer token to accept requests on other hosts"
+                    })
+                    return False
                 if not self._authorized():
                     self._reply(401, {"error": "missing or bad bearer token"})
+                    return False
+                return True
+
+            def do_POST(self):  # noqa: N802
+                if not self._write_guards():
                     return
                 from katib_tpu.utils.http import read_json_body
 
@@ -366,8 +428,7 @@ class SuggestionService:
                     self._reply(404, {"error": "not found"})
 
             def do_DELETE(self):  # noqa: N802
-                if not self._authorized():
-                    self._reply(401, {"error": "missing or bad bearer token"})
+                if not self._write_guards():
                     return
                 prefix = "/api/v1/experiment/"
                 if self.path.startswith(prefix):
@@ -379,6 +440,10 @@ class SuggestionService:
                 pass
 
         server = ThreadingHTTPServer((host, port), Handler)
+        if ssl_context is not None:
+            from katib_tpu.utils.certgen import wrap_server_socket
+
+            server.socket = wrap_server_socket(ssl_context, server.socket)
         thread = threading.Thread(target=server.serve_forever, daemon=True)
         thread.start()
         return RunningService(server, thread)
@@ -399,9 +464,14 @@ class RunningService:
 
 
 def serve_suggestions(
-    port: int = 0, host: str = "127.0.0.1", token: str | None = None
+    port: int = 0,
+    host: str = "127.0.0.1",
+    token: str | None = None,
+    ssl_context=None,
 ) -> RunningService:
-    return SuggestionService().serve(port=port, host=host, token=token)
+    return SuggestionService().serve(
+        port=port, host=host, token=token, ssl_context=ssl_context
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -416,13 +486,17 @@ class LocalSuggesterProcess:
     (``composer/composer.go:72-296``, ``suggestion_controller.go:229-238``).
 
     A fresh auth token is generated per process and passed via environment
-    (never argv, which is world-readable in /proc)."""
+    (never argv, which is world-readable in /proc).  With ``tls=True`` the
+    composer also provisions a private CA + serving cert for the child and
+    the client pins that CA — the reference webhook's rotated-cert setup
+    (``certgenerator/generator.go:37``) collapsed to one handshake."""
 
-    def __init__(self, readiness_timeout: float = 60.0):
+    def __init__(self, readiness_timeout: float = 60.0, tls: bool = True):
         import secrets
         import socket
         import subprocess
         import sys
+        import tempfile
 
         self.token = secrets.token_hex(16)
         # bind-then-release to pick a free port for the child; the tiny race
@@ -430,7 +504,22 @@ class LocalSuggesterProcess:
         with socket.socket() as s:
             s.bind(("127.0.0.1", 0))
             self.port = s.getsockname()[1]
-        self.endpoint = f"http://127.0.0.1:{self.port}"
+        self.ca_cert: str | None = None
+        self._ssl = None
+        extra_args: list[str] = []
+        if tls:
+            from katib_tpu.utils.certgen import client_ssl_context, ensure_certs
+
+            self._cert_dir = tempfile.mkdtemp(prefix="katib-suggest-certs-")
+            bundle = ensure_certs(self._cert_dir)
+            self.ca_cert = bundle.ca_cert
+            self._ssl = client_ssl_context(bundle.ca_cert)
+            extra_args = ["--cert-dir", self._cert_dir]
+            # connect by IP: the child binds IPv4 only, and the leaf carries
+            # an IP SAN for 127.0.0.1 so verification still holds
+            self.endpoint = f"https://127.0.0.1:{self.port}"
+        else:
+            self.endpoint = f"http://127.0.0.1:{self.port}"
         import os as _os
 
         env = dict(_os.environ)
@@ -448,6 +537,11 @@ class LocalSuggesterProcess:
             if env.get("PYTHONPATH")
             else pkg_root
         )
+        # keep the child's output: a child that dies before readiness is
+        # undiagnosable if its traceback went to /dev/null
+        self._log = tempfile.NamedTemporaryFile(
+            mode="w+b", prefix="katib-suggest-", suffix=".log", delete=False
+        )
         self._proc = subprocess.Popen(
             [
                 sys.executable,
@@ -458,9 +552,10 @@ class LocalSuggesterProcess:
                 "127.0.0.1",
                 "--port",
                 str(self.port),
+                *extra_args,
             ],
             env=env,
-            stdout=subprocess.DEVNULL,
+            stdout=self._log,
             stderr=subprocess.STDOUT,
         )
         self._wait_healthy(readiness_timeout)
@@ -472,18 +567,38 @@ class LocalSuggesterProcess:
         last: Exception | None = None
         while _time.monotonic() < deadline:
             if self._proc.poll() is not None:
+                rc = self._proc.returncode
+                tail = self._log_tail()
+                self.stop()  # reclaims the cert dir + log like the timeout path
                 raise RuntimeError(
-                    f"suggester process exited rc={self._proc.returncode} before ready"
+                    f"suggester process exited rc={rc} before ready; "
+                    f"output tail:\n{tail}"
                 )
             try:
-                with urllib.request.urlopen(f"{self.endpoint}/healthz", timeout=2) as r:
+                with urllib.request.urlopen(
+                    f"{self.endpoint}/healthz", timeout=2, context=self._ssl
+                ) as r:
                     if r.status == 200:
                         return
             except OSError as e:
                 last = e
             _time.sleep(0.1)
+        tail = self._log_tail()
         self.stop()
-        raise RuntimeError(f"suggester service never became healthy: {last}")
+        raise RuntimeError(
+            f"suggester service never became healthy: {last}; output tail:\n{tail}"
+        )
+
+    def _log_tail(self, n: int = 2000) -> str:
+        import os as _os
+
+        try:
+            self._log.flush()
+            with open(self._log.name, "rb") as f:
+                f.seek(max(0, _os.path.getsize(self._log.name) - n))
+                return f.read().decode(errors="replace")
+        except OSError:
+            return "<unavailable>"
 
     def stop(self) -> None:
         if self._proc.poll() is None:
@@ -493,6 +608,20 @@ class LocalSuggesterProcess:
             except Exception:
                 self._proc.kill()
                 self._proc.wait(timeout=10)
+        cert_dir = getattr(self, "_cert_dir", None)
+        if cert_dir is not None:
+            import shutil
+
+            shutil.rmtree(cert_dir, ignore_errors=True)
+        log = getattr(self, "_log", None)
+        if log is not None:
+            import os as _os
+
+            try:
+                log.close()
+                _os.unlink(log.name)
+            except OSError:
+                pass
 
 
 # ---------------------------------------------------------------------------
@@ -529,19 +658,30 @@ class RemoteSuggester(Suggester):
         super().__init__(spec)
         endpoint = spec.algorithm.setting("endpoint")
         self._local: LocalSuggesterProcess | None = None
+        self._ssl = None
         if endpoint == "auto":
-            # composer mode: spawn a private suggester service subprocess,
-            # readiness-gated; torn down in close() with the experiment
-            # (``composer.go:72-296`` deploy + ``:132-143`` teardown)
+            # composer mode: spawn a private suggester service subprocess
+            # (TLS + fresh token), readiness-gated; torn down in close() with
+            # the experiment (``composer.go:72-296`` deploy + ``:132-143``)
             self._local = LocalSuggesterProcess()
             endpoint = self._local.endpoint
             self.token: str | None = self._local.token
+            self._ssl = self._local._ssl
         else:
             import os as _os
 
             self.token = spec.algorithm.setting("token") or _os.environ.get(
                 "KATIB_SUGGEST_TOKEN"
             )
+            # ``ca_cert`` pins a private CA for an https endpoint (the
+            # CABundle the reference injects into webhook clientConfig)
+            ca = spec.algorithm.setting("ca_cert") or _os.environ.get(
+                "KATIB_SUGGEST_CA"
+            )
+            if ca:
+                from katib_tpu.utils.certgen import client_ssl_context
+
+                self._ssl = client_ssl_context(ca)
         self.endpoint = endpoint.rstrip("/")
         self.algorithm = spec.algorithm.setting("algorithm")
 
@@ -550,7 +690,7 @@ class RemoteSuggester(Suggester):
         settings = {
             k: v
             for k, v in wire["algorithm"]["settings"].items()
-            if k not in ("endpoint", "algorithm", "token")
+            if k not in ("endpoint", "algorithm", "token", "ca_cert")
         }
         wire["algorithm"] = {"name": self.algorithm, "settings": settings}
         return wire
@@ -579,7 +719,7 @@ class RemoteSuggester(Suggester):
         last: Exception | None = None
         for _ in range(self.RETRIES):
             try:
-                with urllib.request.urlopen(req, timeout=30) as r:
+                with urllib.request.urlopen(req, timeout=30, context=self._ssl) as r:
                     return r.status, safe_json(r.read())
             except urllib.error.HTTPError as e:
                 return e.code, safe_json(e.read())
@@ -599,7 +739,7 @@ class RemoteSuggester(Suggester):
             "settings": {
                 k: v
                 for k, v in experiment.algorithm_settings.items()
-                if k not in ("endpoint", "algorithm", "token")
+                if k not in ("endpoint", "algorithm", "token", "ca_cert")
             },
             "count": count,
             # constant across transport retries: the service replays its
@@ -630,7 +770,7 @@ class RemoteSuggester(Suggester):
             headers=self._headers(),
         )
         try:
-            urllib.request.urlopen(req, timeout=10).close()
+            urllib.request.urlopen(req, timeout=10, context=self._ssl).close()
         except (OSError, urllib.error.HTTPError, http.client.HTTPException):
             pass
         if self._local is not None:

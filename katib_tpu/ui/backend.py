@@ -38,6 +38,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
+from katib_tpu.core.types import ExperimentCondition
 from katib_tpu.orchestrator.status import list_statuses, read_status
 from katib_tpu.store.base import ObservationStore
 
@@ -227,7 +228,7 @@ class UiServer:
         orch.stop()
         return 202, {"ok": True, "stopping": name}
 
-    def delete(self, name: str):
+    def delete(self, name: str, force: bool = False):
         status = read_status(self.workdir, name)
         if status is None:
             return 404, {"error": f"experiment {name!r} not found"}
@@ -235,6 +236,23 @@ class UiServer:
             thread = self._threads.get(name)
             if thread is not None and thread.is_alive():
                 return 409, {"error": f"experiment {name!r} is still running; stop it first"}
+            # the journal may belong to an orchestrator in ANOTHER process
+            # (`katib-tpu run` sharing this workdir) — deleting out from
+            # under it loses its checkpoints mid-run.  A crashed run leaves
+            # a stale non-terminal journal; ?force=1 overrides for that case.
+            condition = str(status.get("condition", ""))
+            try:
+                terminal = ExperimentCondition(condition).is_terminal()
+            except ValueError:
+                terminal = False  # unrecognized journal → treat as live
+            if not terminal and not force:
+                return 409, {
+                    "error": (
+                        f"experiment {name!r} is {condition or 'non-terminal'} "
+                        "(possibly running in another process); stop it first "
+                        "or delete with ?force=1"
+                    )
+                }
             self._runs.pop(name, None)
             self._threads.pop(name, None)
         shutil.rmtree(os.path.join(self.workdir, name), ignore_errors=True)
@@ -316,18 +334,27 @@ class UiServer:
             return self.stop(parts[2])
         return 404, {"error": "not found"}
 
-    def route_delete(self, path: str):
+    def route_delete(self, path: str, query: dict | None = None):
         parts = [p for p in path.split("/") if p]
         if len(parts) == 3 and parts[:2] == ["api", "experiment"]:
-            return self.delete(parts[2])
+            force = (query or {}).get("force", ["0"])[0] not in ("", "0", "false")
+            return self.delete(parts[2], force=force)
         return 404, {"error": "not found"}
 
     # -- server lifecycle ----------------------------------------------------
 
-    def serve(self, port: int = 0, host: str = "127.0.0.1") -> "RunningUi":
+    def serve(
+        self, port: int = 0, host: str = "127.0.0.1", ssl_context=None
+    ) -> "RunningUi":
+        """``ssl_context`` (from ``utils.certgen.server_ssl_context``) serves
+        the dashboard + API over TLS with the rotated self-signed bundle."""
         ui = self
 
         class Handler(BaseHTTPRequestHandler):
+            # bounds a stalled peer (incl. a deferred TLS handshake that
+            # never arrives) to this per-connection thread, not the server
+            timeout = 60
+
             def _send(self, status, payload) -> None:
                 if status == "html":
                     body = payload.encode()
@@ -345,11 +372,36 @@ class UiServer:
                 parsed = urlparse(self.path)
                 self._send(*ui.route(parsed.path, parse_qs(parsed.query)))
 
-            def do_POST(self):  # noqa: N802
-                from katib_tpu.utils.http import bearer_authorized, read_json_body
+            def _write_guards(self) -> bool:
+                """CSRF + DNS-rebinding guards for the write endpoints (the
+                create endpoint runs trialTemplate commands).  JSON-only
+                bodies can't ride a browser "simple" cross-origin request,
+                and in token-less mode the Host header must name this
+                machine so a rebound domain can't become same-origin."""
+                from katib_tpu.utils.http import (
+                    bearer_authorized,
+                    json_content_type,
+                    local_host_allowed,
+                )
 
+                if self.command == "POST" and not json_content_type(self.headers):
+                    self._send(415, {"error": "Content-Type must be application/json"})
+                    return False
+                if ui.token is None and not local_host_allowed(self.headers):
+                    self._send(403, {
+                        "error": "Host not recognized (DNS-rebinding guard); "
+                        "set a bearer token to accept writes on other hosts"
+                    })
+                    return False
                 if not bearer_authorized(self.headers, ui.token):
                     self._send(401, {"error": "missing or bad bearer token"})
+                    return False
+                return True
+
+            def do_POST(self):  # noqa: N802
+                from katib_tpu.utils.http import read_json_body
+
+                if not self._write_guards():
                     return
                 try:
                     payload = read_json_body(self)
@@ -359,17 +411,19 @@ class UiServer:
                 self._send(*ui.route_post(urlparse(self.path).path, payload))
 
             def do_DELETE(self):  # noqa: N802
-                from katib_tpu.utils.http import bearer_authorized
-
-                if not bearer_authorized(self.headers, ui.token):
-                    self._send(401, {"error": "missing or bad bearer token"})
+                if not self._write_guards():
                     return
-                self._send(*ui.route_delete(urlparse(self.path).path))
+                parsed = urlparse(self.path)
+                self._send(*ui.route_delete(parsed.path, parse_qs(parsed.query)))
 
             def log_message(self, *args):
                 pass
 
         server = ThreadingHTTPServer((host, port), Handler)
+        if ssl_context is not None:
+            from katib_tpu.utils.certgen import wrap_server_socket
+
+            server.socket = wrap_server_socket(ssl_context, server.socket)
         thread = threading.Thread(target=server.serve_forever, daemon=True)
         thread.start()
         return RunningUi(server, thread)
@@ -391,9 +445,11 @@ class RunningUi:
 
 def start_ui(
     workdir: str, store: ObservationStore | None = None, port: int = 0,
-    host: str = "127.0.0.1", token: str | None = None,
+    host: str = "127.0.0.1", token: str | None = None, ssl_context=None,
 ) -> RunningUi:
-    return UiServer(workdir, store, token=token).serve(port=port, host=host)
+    return UiServer(workdir, store, token=token).serve(
+        port=port, host=host, ssl_context=ssl_context
+    )
 
 
 # -- the dashboard (single file, no build step) ------------------------------

@@ -22,6 +22,46 @@ def bearer_authorized(headers, token: str | None) -> bool:
         return False
 
 
+def local_host_allowed(headers) -> bool:
+    """DNS-rebinding guard for token-less servers: the Content-Type check
+    stops cross-origin *requests*, but a malicious domain can rebind its DNS
+    to 127.0.0.1 and become same-origin — so when no bearer token protects
+    the writes, the ``Host`` header must name this machine (localhost, its
+    hostname, or one of its addresses; extend via ``KATIB_ALLOWED_HOSTS``,
+    comma-separated).  Token-protected deployments skip this check — the
+    token already gates the write, and their legit DNS names are unknowable
+    here."""
+    import os
+    import socket
+    from urllib.parse import urlsplit
+
+    try:
+        name = (urlsplit("//" + (headers.get("Host") or "")).hostname or "").lower()
+    except ValueError:
+        return False
+    if not name:
+        return False
+    allowed = {"localhost", "127.0.0.1", "::1"}
+    try:
+        hostname = socket.gethostname().lower()
+        allowed.add(hostname)
+        allowed.update(socket.gethostbyname_ex(hostname)[2])
+    except OSError:
+        pass
+    extra = os.environ.get("KATIB_ALLOWED_HOSTS", "")
+    allowed.update(h.strip().lower() for h in extra.split(",") if h.strip())
+    return name in allowed
+
+
+def json_content_type(headers) -> bool:
+    """True iff the request declares ``Content-Type: application/json``.
+    Enforcing this on state-changing endpoints is the CSRF guard: a JSON
+    content type can't ride a browser's "simple" cross-origin request, so
+    the attempt dies in a CORS preflight this server never answers."""
+    ctype = (headers.get("Content-Type") or "").split(";")[0].strip().lower()
+    return ctype == "application/json"
+
+
 def read_json_body(handler) -> dict:
     """Read and parse the request body of a ``BaseHTTPRequestHandler`` as a
     JSON object.  Raises ``ValueError`` on anything malformed."""

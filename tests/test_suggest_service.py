@@ -348,6 +348,66 @@ class TestAuthAndIdempotency:
             _REGISTRY.pop("counting-stub", None)
 
 
+class TestServiceGuards:
+    def test_nested_remote_rejected(self):
+        """A service must refuse to serve algorithm 'remote' — a validate or
+        suggestions call would otherwise spawn composer subprocesses on the
+        server at any network caller's request."""
+        svc = SuggestionService()
+        wire = spec_to_wire(
+            _spec(algorithm="remote", name="nested",
+                  settings={"endpoint": "auto", "algorithm": "tpe"})
+        )
+        status, reply = svc.validate({"spec": wire})
+        assert status == 400 and "remote" in reply["error"]
+        status, reply = svc.suggestions({"spec": wire, "trials": [], "count": 1})
+        assert status == 400 and "remote" in reply["error"]
+
+    def test_validate_does_not_instantiate(self):
+        """validate() must use class-level validation, never construction
+        (constructors can have side effects like subprocess spawns)."""
+        from katib_tpu.suggest.base import _REGISTRY, Suggester, register
+
+        constructed = {"n": 0}
+
+        @register("spawny-stub")
+        class SpawnyStub(Suggester):
+            def __init__(self, spec):
+                constructed["n"] += 1
+                super().__init__(spec)
+
+            def get_suggestions(self, experiment, count):
+                return []
+
+        try:
+            svc = SuggestionService()
+            wire = spec_to_wire(_spec(algorithm="spawny-stub", name="novalidate"))
+            status, _ = svc.validate({"spec": wire})
+            assert status == 200
+            assert constructed["n"] == 0
+        finally:
+            _REGISTRY.pop("spawny-stub", None)
+
+    def test_tokenless_service_rejects_foreign_host(self):
+        import urllib.error
+
+        from katib_tpu.suggest.service import serve_suggestions
+
+        svc = serve_suggestions()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{svc.port}/api/v1/validate", data=b"{}",
+                headers={"Content-Type": "application/json", "Host": "evil.example"},
+            )
+            try:
+                urllib.request.urlopen(req, timeout=5)
+                raise AssertionError("expected 403")
+            except urllib.error.HTTPError as e:
+                assert e.code == 403
+        finally:
+            svc.stop()
+
+
 class TestComposerLifecycle:
     def test_auto_spawn_health_gate_teardown(self, tmp_path):
         """endpoint: auto spawns a private suggest-server subprocess,

@@ -271,6 +271,70 @@ class TestUiWritePath:
         finally:
             ui.stop()
 
+    def test_post_rejects_non_json_content_type(self, tmp_path):
+        """CSRF guard: a browser "simple" request (text/plain, as sent by a
+        cross-origin no-cors fetch) must be refused before it can reach the
+        command-executing create endpoint."""
+        ui = start_ui(str(tmp_path))
+        try:
+            body = json.dumps({"yaml": EXP_YAML.format(name="csrf")}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{ui.port}/api/experiments", data=body,
+                headers={"Content-Type": "text/plain"},
+            )
+            try:
+                urllib.request.urlopen(req)
+                raise AssertionError("expected 415")
+            except urllib.error.HTTPError as e:
+                assert e.code == 415
+        finally:
+            ui.stop()
+
+    def test_delete_refuses_foreign_running_journal(self, tmp_path):
+        """A non-terminal journal may belong to an orchestrator in another
+        process; DELETE must refuse it without ?force=1 (else it rmtree's a
+        live workdir mid-run)."""
+        import os
+
+        exp_dir = tmp_path / "other-proc"
+        os.makedirs(exp_dir)
+        (exp_dir / "status.json").write_text(json.dumps(
+            {"name": "other-proc", "condition": "Running", "trials": {}}
+        ))
+        ui = start_ui(str(tmp_path))
+        try:
+            status, reply = _delete(ui.port, "/api/experiment/other-proc")
+            assert status == 409 and "force" in reply["error"]
+            assert exp_dir.exists()
+            status, _ = _delete(ui.port, "/api/experiment/other-proc?force=1")
+            assert status == 200
+            assert not exp_dir.exists()
+        finally:
+            ui.stop()
+
+    def test_tokenless_writes_reject_foreign_host(self, tmp_path):
+        """DNS-rebinding guard: with no token configured, a write whose Host
+        header names a foreign domain (a rebound attacker origin) is 403."""
+        ui = start_ui(str(tmp_path))
+        try:
+            body = json.dumps({"yaml": EXP_YAML.format(name="rebind")}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{ui.port}/api/experiments", data=body,
+                headers={"Content-Type": "application/json", "Host": "evil.example"},
+            )
+            try:
+                urllib.request.urlopen(req)
+                raise AssertionError("expected 403")
+            except urllib.error.HTTPError as e:
+                assert e.code == 403
+            # the normal localhost Host header still works
+            status, reply = _post(
+                ui.port, "/api/experiments", {"yaml": EXP_YAML.format(name="rebind")}
+            )
+            assert status == 201
+        finally:
+            ui.stop()
+
     def test_write_auth_token(self, tmp_path):
         ui = start_ui(str(tmp_path), token="hunter2")
         try:
