@@ -135,23 +135,28 @@ def register(name: str) -> Callable[[Type[Suggester]], Type[Suggester]]:
     return deco
 
 
-def make_suggester(spec: ExperimentSpec) -> Suggester:
-    """Instantiate the registered suggester for an experiment spec — the
-    analog of the composer resolving the algorithm image from KatibConfig
-    (``composer.go:72``)."""
+def _resolve(name: str) -> Type[Suggester]:
+    """Registry lookup with the lazy-import fallback — shared by construction
+    and validation so the two paths can never drift on what's resolvable."""
     # import for registration side effects
     import importlib
 
     from katib_tpu.suggest import algorithms  # noqa: F401
 
-    name = spec.algorithm.name
     if name not in _REGISTRY and name in algorithms.LAZY_ALGORITHMS:
         importlib.import_module(algorithms.LAZY_ALGORITHMS[name])
     if name not in _REGISTRY:
         raise SuggesterError(
             f"unknown algorithm {name!r}; registered: {sorted(registered_algorithms())}"
         )
-    return _REGISTRY[name](spec)
+    return _REGISTRY[name]
+
+
+def make_suggester(spec: ExperimentSpec) -> Suggester:
+    """Instantiate the registered suggester for an experiment spec — the
+    analog of the composer resolving the algorithm image from KatibConfig
+    (``composer.go:72``)."""
+    return _resolve(spec.algorithm.name)(spec)
 
 
 def validate_spec(spec: ExperimentSpec) -> None:
@@ -160,18 +165,7 @@ def validate_spec(spec: ExperimentSpec) -> None:
     service subprocess), which a validate-only caller must never trigger —
     the analog of ``ValidateAlgorithmSettings`` being a separate RPC from
     suggestion serving."""
-    import importlib
-
-    from katib_tpu.suggest import algorithms  # noqa: F401
-
-    name = spec.algorithm.name
-    if name not in _REGISTRY and name in algorithms.LAZY_ALGORITHMS:
-        importlib.import_module(algorithms.LAZY_ALGORITHMS[name])
-    if name not in _REGISTRY:
-        raise SuggesterError(
-            f"unknown algorithm {name!r}; registered: {sorted(registered_algorithms())}"
-        )
-    _REGISTRY[name].validate(spec)
+    _resolve(spec.algorithm.name).validate(spec)
 
 
 def registered_algorithms() -> list[str]:

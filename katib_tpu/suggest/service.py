@@ -288,7 +288,11 @@ class SuggestionService:
     def forget(self, name: str) -> tuple[int, dict]:
         with self._lock:
             entry = self._entries.pop(name, None)
-        self._close_entry(entry)
+        if entry is not None:
+            # serialize teardown behind any in-flight get_suggestions on
+            # the same entry (its lock is taken for the whole call)
+            with entry.lock:
+                self._close_entry(entry)
         return (200, {"ok": True}) if entry else (404, {"error": f"unknown experiment {name!r}"})
 
     def suggestions(self, payload: dict) -> tuple[int, dict]:
@@ -311,7 +315,9 @@ class SuggestionService:
                     self._entries[spec.name] = entry
         except SuggesterError as e:
             return 400, {"error": str(e)}
-        self._close_entry(evicted)
+        if evicted is not None:
+            with evicted.lock:  # wait out any in-flight call on the old entry
+                self._close_entry(evicted)
         exp = Experiment(spec=spec)
         exp.trials = {
             t["name"]: trial_from_wire(t) for t in payload.get("trials") or ()
@@ -508,8 +514,23 @@ class LocalSuggesterProcess:
         self._ssl = None
         extra_args: list[str] = []
         if tls:
-            from katib_tpu.utils.certgen import client_ssl_context, ensure_certs
+            # TLS needs the optional `cryptography` extra; a base install
+            # degrades to the pre-TLS localhost behavior instead of crashing
+            # mid-experiment (the token still gates the child either way)
+            try:
+                from katib_tpu.utils.certgen import client_ssl_context, ensure_certs
+                import cryptography  # noqa: F401
+            except ImportError:
+                import warnings
 
+                warnings.warn(
+                    "cryptography not installed; composer suggester will "
+                    "serve plain HTTP on 127.0.0.1 (install katib-tpu[tls])",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+                tls = False
+        if tls:
             self._cert_dir = tempfile.mkdtemp(prefix="katib-suggest-certs-")
             bundle = ensure_certs(self._cert_dir)
             self.ca_cert = bundle.ca_cert

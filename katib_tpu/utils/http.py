@@ -4,6 +4,7 @@ JSON body reading so the two servers cannot drift."""
 
 from __future__ import annotations
 
+import functools as _functools
 import hmac
 import json
 
@@ -32,7 +33,6 @@ def local_host_allowed(headers) -> bool:
     token already gates the write, and their legit DNS names are unknowable
     here."""
     import os
-    import socket
     from urllib.parse import urlsplit
 
     try:
@@ -41,6 +41,19 @@ def local_host_allowed(headers) -> bool:
         return False
     if not name:
         return False
+    allowed = set(_machine_hosts())
+    extra = os.environ.get("KATIB_ALLOWED_HOSTS", "")
+    allowed.update(h.strip().lower() for h in extra.split(",") if h.strip())
+    return name in allowed
+
+
+@_functools.lru_cache(maxsize=1)
+def _machine_hosts() -> frozenset[str]:
+    """This machine's names/addresses — effectively static, and
+    ``gethostbyname_ex`` can mean a real (slow) DNS query, so resolve once,
+    not per request."""
+    import socket
+
     allowed = {"localhost", "127.0.0.1", "::1"}
     try:
         hostname = socket.gethostname().lower()
@@ -48,9 +61,7 @@ def local_host_allowed(headers) -> bool:
         allowed.update(socket.gethostbyname_ex(hostname)[2])
     except OSError:
         pass
-    extra = os.environ.get("KATIB_ALLOWED_HOSTS", "")
-    allowed.update(h.strip().lower() for h in extra.split(",") if h.strip())
-    return name in allowed
+    return frozenset(allowed)
 
 
 def json_content_type(headers) -> bool:
