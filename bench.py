@@ -175,9 +175,9 @@ def _child() -> None:
     img_per_sec = BATCH * TIMED_STEPS / dt
     step_secs = dt / TIMED_STEPS
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
-    # MFU denominator must match the compute dtype: the v5e MXU peaks at
-    # 197 TFLOP/s only in bf16; these f32 tensors get half that
-    dtype_key = "bf16" if x.dtype == jnp.bfloat16 else "f32"
+    # MFU denominator must match the COMPUTE dtype (the supernet casts to
+    # its flax compute dtype internally — f32 inputs still run bf16 matmuls)
+    dtype_key = "bf16" if net.dtype == jnp.bfloat16 else "f32"
     peak = PEAK_FLOPS.get((gen, dtype_key), PEAK_FLOPS[("v5e", dtype_key)])
     mfu = (flops_per_step / step_secs) / peak if flops_per_step else 0.0
     print(

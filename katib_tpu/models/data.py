@@ -106,6 +106,13 @@ def _load_or_synthesize(
     return synthetic_classification(n_train, n_test, shape, num_classes, seed=seed)
 
 
+def using_real_data(name: str) -> bool:
+    """True when a cached real ``.npz`` backs ``name`` (vs the synthetic
+    fallback) — run logs record this so synthetic separability is never
+    mistaken for real-dataset accuracy."""
+    return _find_npz(name) is not None
+
+
 def load_mnist(n_train: int = 8192, n_test: int = 2048) -> Dataset:
     return _load_or_synthesize("mnist", (28, 28, 1), 10, n_train, n_test)
 

@@ -221,6 +221,10 @@ class _Entry:
         # (TPE store / PBT queue / ENAS controller) are not thread-safe, and
         # ThreadingHTTPServer handles each POST on its own thread
         self.lock = threading.Lock()
+        # set (under lock) when the suggester has been torn down; a request
+        # that raced the teardown sees it and backs off instead of calling
+        # into a closed suggester
+        self.closed = False
         # idempotency: a retried POST whose first response was lost must not
         # advance stateful suggesters (grid/sobol/hyperband) a second time —
         # the last request id replays its stored reply instead
@@ -276,8 +280,13 @@ class SuggestionService:
     @staticmethod
     def _close_entry(entry: "_Entry | None") -> None:
         """Best-effort resource teardown for an evicted/forgotten suggester
-        (anything holding processes/sockets exposes ``close``)."""
-        close = getattr(entry.suggester, "close", None) if entry else None
+        (anything holding processes/sockets exposes ``close``).  Caller must
+        hold ``entry.lock``; the ``closed`` flag tells a request thread that
+        looked the entry up before the pop/evict not to use it."""
+        if entry is None:
+            return
+        entry.closed = True
+        close = getattr(entry.suggester, "close", None)
         if close is None:
             return
         try:
@@ -328,6 +337,14 @@ class SuggestionService:
             }
         request_id = payload.get("request_id")
         with entry.lock:
+            if entry.closed:
+                # raced a forget()/evict between the registry lookup and
+                # here; the registry no longer holds this entry, so a retry
+                # builds a fresh suggester (409 → client NotReady → retry)
+                return 409, {
+                    "error": "suggester was torn down concurrently; retry",
+                    "code": "not_ready",
+                }
             if (
                 request_id is not None
                 and request_id == entry.last_request_id

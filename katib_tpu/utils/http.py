@@ -47,11 +47,22 @@ def local_host_allowed(headers) -> bool:
     return name in allowed
 
 
-@_functools.lru_cache(maxsize=1)
+_HOSTS_TTL_S = 60.0
+_hosts_cache: tuple[float, frozenset[str]] | None = None
+
+
 def _machine_hosts() -> frozenset[str]:
-    """This machine's names/addresses — effectively static, and
-    ``gethostbyname_ex`` can mean a real (slow) DNS query, so resolve once,
-    not per request."""
+    """This machine's names/addresses.  ``gethostbyname_ex`` can mean a real
+    (slow) DNS query, so don't resolve per request — but don't cache forever
+    either: a resolver that was down at first request, or an address that
+    changed (DHCP), must converge within the TTL instead of pinning a
+    degraded set for the process lifetime."""
+    global _hosts_cache
+    import time
+
+    now = time.monotonic()
+    if _hosts_cache is not None and now - _hosts_cache[0] < _HOSTS_TTL_S:
+        return _hosts_cache[1]
     import socket
 
     allowed = {"localhost", "127.0.0.1", "::1"}
@@ -61,7 +72,8 @@ def _machine_hosts() -> frozenset[str]:
         allowed.update(socket.gethostbyname_ex(hostname)[2])
     except OSError:
         pass
-    return frozenset(allowed)
+    _hosts_cache = (now, frozenset(allowed))
+    return _hosts_cache[1]
 
 
 def json_content_type(headers) -> bool:
