@@ -1,0 +1,66 @@
+"""Shared bootstrap for the repo's run scripts — one copy of the
+environment/bootstrap logic so the four harnesses can't drift.
+
+Import `REPO` and call `setup_jax(...)` BEFORE importing jax-heavy modules.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def setup_jax(
+    *,
+    force_platform: str | None = None,
+    virtual_devices: int = 0,
+    compile_cache: bool = False,
+):
+    """Configure JAX and return the imported module.
+
+    ``force_platform``: hard-select a platform (CPU demos pass "cpu" — the
+    ambient env on this box exports JAX_PLATFORMS=axon, i.e. the TPU, and a
+    setdefault would silently send a CPU demo to a possibly-wedged pool).
+    ``None`` honors the ambient JAX_PLATFORMS (TPU benches).
+    ``virtual_devices``: forced-host-platform CPU device count for mesh demos.
+    ``compile_cache``: persist XLA executables under .jax_cache (TPU benches).
+    """
+    if virtual_devices and "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""
+    ):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={virtual_devices}"
+        ).strip()
+    if force_platform is not None:
+        os.environ["JAX_PLATFORMS"] = force_platform
+
+    import jax
+
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        # the axon PJRT plugin ignores the env var; set the config explicitly
+        jax.config.update("jax_platforms", want)
+    if compile_cache:
+        try:
+            jax.config.update(
+                "jax_compilation_cache_dir", os.path.join(REPO, ".jax_cache")
+            )
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+        except Exception:
+            pass  # cache flags are version-dependent
+    return jax
+
+
+def write_artifact(subdir: str, name: str, payload: dict) -> str:
+    out_dir = os.path.join(REPO, "artifacts", subdir)
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, name)
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2)
+    return path

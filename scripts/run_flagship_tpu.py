@@ -28,22 +28,12 @@ import os
 import sys
 import time
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import REPO, setup_jax, write_artifact  # noqa: E402
 
 
 def main() -> int:
-    import jax
-
-    want = os.environ.get("JAX_PLATFORMS")
-    if want:
-        jax.config.update("jax_platforms", want)
-    cache_dir = os.path.join(REPO, ".jax_cache")
-    try:
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
-    except Exception:
-        pass
+    jax = setup_jax(compile_cache=True)
 
     small = os.environ.get("FLAGSHIP_SMALL", "") not in ("", "0")
     epochs = int(os.environ.get("FLAGSHIP_EPOCHS", "1" if small else "3"))
@@ -96,26 +86,24 @@ def main() -> int:
 
     steps_per_epoch = max(1, (len(dataset.x_train) // 2) // batch)
     total_steps = steps_per_epoch * epochs
-    # first epoch carries the XLA compile; steady-state rate excludes it
-    steady = epoch_times[1:] or epoch_times
+    # first epoch carries the XLA compile; the steady-state rate excludes it
+    # and is reported as null when there is no compile-free epoch to measure
+    steady = epoch_times[1:]
     img_per_sec = (
-        steps_per_epoch * batch * len(steady) / sum(steady) if sum(steady) else 0.0
+        steps_per_epoch * batch * len(steady) / sum(steady) if steady else None
     )
 
-    out_dir = os.path.join(REPO, "artifacts", "flagship")
-    os.makedirs(out_dir, exist_ok=True)
     genotype = result["genotype"]
-    with open(os.path.join(out_dir, "genotype.json"), "w") as f:
-        json.dump(
-            {
-                "normal": genotype.normal,
-                "reduce": genotype.reduce,
-                "best_accuracy": result["best_accuracy"],
-                "rendered": genotype.render(),
-            },
-            f,
-            indent=2,
-        )
+    write_artifact(
+        "flagship",
+        "genotype.json",
+        {
+            "normal": genotype.normal,
+            "reduce": genotype.reduce,
+            "best_accuracy": result["best_accuracy"],
+            "rendered": genotype.render(),
+        },
+    )
     log = {
         "config": {
             "num_layers": num_layers,
@@ -130,13 +118,14 @@ def main() -> int:
         "real_data": using_real_data("cifar10"),
         "wallclock_s": round(wall, 1),
         "epoch_secs": [round(t, 2) for t in epoch_times],
-        "steady_state_images_per_sec": round(img_per_sec, 2),
+        "steady_state_images_per_sec": (
+            round(img_per_sec, 2) if img_per_sec is not None else None
+        ),
         "total_bilevel_steps": total_steps,
         "best_accuracy": result["best_accuracy"],
         "accuracy_vs_wallclock": result["history"],
     }
-    with open(os.path.join(out_dir, "run_log.json"), "w") as f:
-        json.dump(log, f, indent=2)
+    write_artifact("flagship", "run_log.json", log)
     print(json.dumps({k: log[k] for k in (
         "platform", "real_data", "wallclock_s", "steady_state_images_per_sec",
         "best_accuracy",

@@ -21,24 +21,16 @@ import os
 import sys
 import time
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
-
-if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-    ).strip()
-# hard-set, not setdefault: the ambient env on this box exports
-# JAX_PLATFORMS=axon (the TPU), and this sweep is a CPU-mesh demo — going
-# to the TPU would serialize 8-way trial parallelism onto one chip (or hang
-# on a wedged pool).  SWEEP_PLATFORM overrides deliberately.
-os.environ["JAX_PLATFORMS"] = os.environ.get("SWEEP_PLATFORM", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import REPO, setup_jax, write_artifact  # noqa: E402
 
 
 def main() -> int:
-    import jax
-
-    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    # CPU-mesh demo: 8-way trial parallelism would serialize onto the one
+    # TPU chip (or hang on a wedged pool); SWEEP_PLATFORM overrides
+    jax = setup_jax(
+        force_platform=os.environ.get("SWEEP_PLATFORM", "cpu"), virtual_devices=8
+    )
 
     from katib_tpu.core.types import (
         AlgorithmSpec,
@@ -139,15 +131,12 @@ def main() -> int:
         "rungs": dict(sorted(rungs.items())),
         "best_objective_vs_wallclock": best_curve,
     }
-    out_dir = os.path.join(REPO, "artifacts", "hyperband")
-    os.makedirs(out_dir, exist_ok=True)
-    with open(os.path.join(out_dir, "sweep_summary.json"), "w") as f:
-        json.dump(summary, f, indent=2)
+    write_artifact("hyperband", "sweep_summary.json", summary)
     print(json.dumps({k: summary[k] for k in (
         "condition", "trials_total", "wallclock_s", "trials_per_hour",
         "best_objective",
     )}), flush=True)
-    return 0 if exp.succeeded_count == 32 else 1
+    return 0 if exp.succeeded_count == spec.max_trial_count else 1
 
 
 if __name__ == "__main__":
