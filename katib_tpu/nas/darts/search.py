@@ -51,6 +51,7 @@ def run_darts_search(
     mesh=None,
     seed: int = 0,
     report=None,
+    native_prefetch: bool | None = None,
 ) -> dict[str, Any]:
     """Run the bilevel architecture search; returns genotype + final metrics."""
     net = DartsNetwork(
@@ -99,43 +100,92 @@ def run_darts_search(
     if mesh is not None:
         state = replicate(state, mesh)
 
+    # optional native prefetch: C++ worker threads gather the next shuffled
+    # batch while the device runs the current bilevel step (enable with
+    # native_prefetch=True or KATIB_NATIVE_LOADER=1; falls back silently
+    # when the native runtime isn't built)
+    if native_prefetch is None:
+        native_prefetch = os.environ.get("KATIB_NATIVE_LOADER", "") not in ("", "0")
+    native_loaders = None
+    loader_cache_dir = None
+    if native_prefetch:
+        from katib_tpu.native import native_available
+
+        if native_available():
+            import tempfile
+
+            from katib_tpu.native import NativeBatchLoader
+
+            loader_cache_dir = tempfile.mkdtemp(prefix="darts-loader-")
+            # equal record counts keep the two epoch streams in lockstep
+            # (the a-half can be 1 longer when n is odd; an extra sample
+            # would desync the C loaders' positional epoch boundaries)
+            n_sync = len(x_w)
+            native_loaders = (
+                NativeBatchLoader(
+                    x_w, y_w, batch=batch_size, seed=seed,
+                    cache_path=os.path.join(loader_cache_dir, "w.bin"),
+                ),
+                NativeBatchLoader(
+                    x_a[:n_sync], y_a[:n_sync], batch=batch_size, seed=seed + 1,
+                    cache_path=os.path.join(loader_cache_dir, "a.bin"),
+                ),
+            )
+
     best_acc = 0.0
     history = []
     t0 = time.perf_counter()
-    for epoch in range(num_epochs):
-        w_stream = batches(x_w, y_w, batch_size, rng)
-        a_stream = batches(x_a, y_a, batch_size, rng)
-        train_loss = 0.0
-        steps = 0
-        for wb, ab in zip(w_stream, a_stream):
-            if mesh is not None:
-                wb, ab = shard_batch(wb, mesh), shard_batch(ab, mesh)
-            state, metrics = search_step(state, wb, ab)
-            train_loss += float(metrics["train_loss"])
-            steps += 1
+    try:
+        for epoch in range(num_epochs):
+            if native_loaders is not None:
+                w_stream = native_loaders[0].epoch()
+                a_stream = native_loaders[1].epoch()
+            else:
+                w_stream = batches(x_w, y_w, batch_size, rng)
+                a_stream = batches(x_a, y_a, batch_size, rng)
+            train_loss = 0.0
+            steps = 0
+            for wb, ab in zip(w_stream, a_stream):
+                if mesh is not None:
+                    wb, ab = shard_batch(wb, mesh), shard_batch(ab, mesh)
+                state, metrics = search_step(state, wb, ab)
+                train_loss += float(metrics["train_loss"])
+                steps += 1
 
-        ne = min(len(dataset.x_test), 1024)
-        eval_batch = (dataset.x_test[:ne], dataset.y_test[:ne])
-        if mesh is not None:
-            eval_batch = shard_batch(eval_batch, mesh)
-        em = evaluate((state.weights, state.alphas), eval_batch)
-        val_acc = float(em["accuracy"])
-        best_acc = max(best_acc, val_acc)
-        history.append(
-            {
-                "epoch": epoch,
-                "val_accuracy": val_acc,
-                "train_loss": train_loss / max(steps, 1),
-                # best-objective@wallclock is the BASELINE driver metric;
-                # every row carries elapsed seconds so the curve is plottable
-                "elapsed_s": round(time.perf_counter() - t0, 3),
-                "best_accuracy": best_acc,
-            }
-        )
-        if report is not None:
-            cont = report(epoch=epoch, accuracy=val_acc, loss=train_loss / max(steps, 1))
-            if cont is False:
-                break
+            ne = min(len(dataset.x_test), 1024)
+            eval_batch = (dataset.x_test[:ne], dataset.y_test[:ne])
+            if mesh is not None:
+                eval_batch = shard_batch(eval_batch, mesh)
+            em = evaluate((state.weights, state.alphas), eval_batch)
+            val_acc = float(em["accuracy"])
+            best_acc = max(best_acc, val_acc)
+            history.append(
+                {
+                    "epoch": epoch,
+                    "val_accuracy": val_acc,
+                    "train_loss": train_loss / max(steps, 1),
+                    # best-objective@wallclock is the BASELINE driver metric;
+                    # every row carries elapsed seconds so the curve is
+                    # plottable
+                    "elapsed_s": round(time.perf_counter() - t0, 3),
+                    "best_accuracy": best_acc,
+                }
+            )
+            if report is not None:
+                cont = report(
+                    epoch=epoch, accuracy=val_acc, loss=train_loss / max(steps, 1)
+                )
+                if cont is False:
+                    break
+    finally:
+        # an exception mid-epoch must not leak C++ worker threads, the
+        # mmap, or a dataset-sized temp dir
+        if native_loaders is not None:
+            import shutil
+
+            for dl in native_loaders:
+                dl.close()
+            shutil.rmtree(loader_cache_dir, ignore_errors=True)
 
     genotype = extract_genotype(
         jax.device_get(state.alphas), primitives, n_nodes=n_nodes

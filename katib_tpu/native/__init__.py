@@ -5,6 +5,9 @@
 - ``spawn_db_manager`` / ``RemoteObservationStore`` — standalone metrics
   daemon + wire client, the cross-process parity of the reference's
   DB-manager gRPC service.
+- ``NativeBatchLoader`` / ``pack_dataset`` — mmap'd prefetching batch
+  loader (C++ worker threads gather shuffled batches; the torch-DataLoader
+  analog for the white-box JAX trial loop).
 
 Everything degrades gracefully: ``native_available()`` is False when no C++
 toolchain is present and callers fall back to the pure-Python backends.
@@ -13,11 +16,13 @@ toolchain is present and callers fall back to the pure-Python backends.
 from katib_tpu.native.build import build_error, ensure_built, native_available
 
 __all__ = [
+    "NativeBatchLoader",
     "NativeObservationStore",
     "RemoteObservationStore",
     "build_error",
     "ensure_built",
     "native_available",
+    "pack_dataset",
     "parse_text_lines_native",
     "spawn_db_manager",
 ]
@@ -36,4 +41,8 @@ def __getattr__(name):  # lazy: importing the package must not trigger a build
         from katib_tpu.native import dbmanager
 
         return getattr(dbmanager, name)
+    if name in ("NativeBatchLoader", "pack_dataset"):
+        from katib_tpu.native import dataloader
+
+        return getattr(dataloader, name)
     raise AttributeError(name)

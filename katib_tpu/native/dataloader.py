@@ -1,0 +1,151 @@
+"""ctypes wrapper for the native batch loader (``src/dataloader.cc``).
+
+``NativeBatchLoader`` streams deterministically-shuffled (x, y) batches from
+a memory-mapped record file with C++ worker threads doing the gather —
+host-side batch assembly overlaps device compute, the TPU-native answer to
+the reference trial images' torch-DataLoader/tf.data input pipelines.
+
+The record file is built once per (dataset, cache_dir) by ``pack_dataset``:
+each record is one sample's image bytes followed by its label bytes,
+contiguous, so a batch gather is ``batch`` memcpys from the mapping.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from katib_tpu.native.build import ensure_built, load_lib
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.ktl_open.restype = ctypes.c_void_p
+    lib.ktl_open.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
+        ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint32,
+    ]
+    lib.ktl_next.restype = ctypes.c_int64
+    lib.ktl_next.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.ktl_epoch.restype = ctypes.c_uint64
+    lib.ktl_epoch.argtypes = [ctypes.c_void_p]
+    lib.ktl_batches_per_epoch.restype = ctypes.c_uint64
+    lib.ktl_batches_per_epoch.argtypes = [ctypes.c_void_p]
+    lib.ktl_close.restype = None
+    lib.ktl_close.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def pack_dataset(x: np.ndarray, y: np.ndarray, path: str) -> tuple[int, int]:
+    """Write (x[i] || y[i]) records to ``path``; returns (record_bytes, n).
+
+    An existing file of exactly the expected size is reused without
+    rewriting (size-only heuristic — callers that pack DIFFERENT data of
+    identical shape to the same path must remove the file first; the
+    framework's own cache paths are per-run temp dirs, so reuse only ever
+    sees the same arrays)."""
+    x = np.ascontiguousarray(x)
+    y = np.ascontiguousarray(y)
+    n = len(x)
+    assert len(y) == n and n > 0
+    record_bytes = (x.nbytes + y.nbytes) // n
+    try:
+        if os.path.getsize(path) == record_bytes * n:
+            return record_bytes, n
+    except OSError:
+        pass
+    xb = x.reshape(n, -1).view(np.uint8).reshape(n, -1)
+    yb = y.reshape(n, -1).view(np.uint8).reshape(n, -1)
+    rec = np.concatenate([xb, yb], axis=1)
+    tmp = path + ".tmp"
+    rec.tofile(tmp)
+    os.replace(tmp, path)
+    return rec.shape[1], n
+
+
+class NativeBatchLoader:
+    """Iterate epochs of shuffled batches gathered by C++ worker threads.
+
+    Deterministic: epoch ``e`` of a loader with seed ``s`` always yields the
+    same batches in the same order, independent of thread count.
+    """
+
+    def __init__(
+        self,
+        x: np.ndarray,
+        y: np.ndarray,
+        *,
+        batch: int,
+        seed: int = 0,
+        cache_path: str,
+        n_threads: int = 2,
+        queue_cap: int = 8,
+    ):
+        if not ensure_built():
+            raise RuntimeError("native runtime unavailable (no C++ toolchain)")
+        self._lib = _bind(load_lib())
+        self.x_shape = x.shape[1:]
+        self.x_dtype = x.dtype
+        self.y_shape = y.shape[1:]
+        self.y_dtype = y.dtype
+        self._x_bytes = int(np.prod(self.x_shape, dtype=np.int64)) * x.dtype.itemsize
+        self._y_bytes = (
+            int(np.prod(self.y_shape, dtype=np.int64) or 1) * y.dtype.itemsize
+        )
+        self.batch = batch
+        record_bytes, n = pack_dataset(x, y, cache_path)
+        assert record_bytes == self._x_bytes + self._y_bytes
+        self._h = self._lib.ktl_open(
+            cache_path.encode(), record_bytes, n, batch, seed, n_threads, queue_cap
+        )
+        if not self._h:
+            raise RuntimeError(f"ktl_open failed for {cache_path}")
+        self._record_bytes = record_bytes
+        self._buf = ctypes.create_string_buffer(batch * record_bytes)
+
+    @property
+    def batches_per_epoch(self) -> int:
+        return int(self._lib.ktl_batches_per_epoch(self._h))
+
+    def epoch(self):
+        """Yield this epoch's (x, y) batches (drop-last semantics)."""
+        for _ in range(self.batches_per_epoch):
+            got = self._lib.ktl_next(self._h, self._buf)
+            if got != self.batch:
+                raise RuntimeError(f"native loader returned {got}")
+            raw = np.frombuffer(self._buf, dtype=np.uint8).reshape(
+                self.batch, self._record_bytes
+            )
+            xb = (
+                raw[:, : self._x_bytes]
+                .copy()
+                .view(self.x_dtype)
+                .reshape(self.batch, *self.x_shape)
+            )
+            yb = (
+                raw[:, self._x_bytes:]
+                .copy()
+                .view(self.y_dtype)
+                .reshape(self.batch, *self.y_shape)
+                if self.y_shape
+                else raw[:, self._x_bytes:].copy().view(self.y_dtype).reshape(self.batch)
+            )
+            yield xb, yb
+
+    def close(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.ktl_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):  # best-effort; close() is the real API
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -259,3 +259,25 @@ class TestEnasService:
         s2 = make_suggester(spec)
         s2.load_state_dict(data)
         assert s2.round == 1
+
+
+class TestNativePrefetchSearch:
+    def test_search_with_native_loader(self):
+        """run_darts_search(native_prefetch=True) streams batches through the
+        C++ loader and completes identically-shaped results."""
+        from katib_tpu.models.data import synthetic_classification
+        from katib_tpu.nas.darts.architect import DartsHyper
+        from katib_tpu.nas.darts.search import run_darts_search
+        from katib_tpu.native import native_available
+
+        if not native_available():
+            pytest.skip("C++ toolchain unavailable")
+        ds = synthetic_classification(96, 48, (12, 12, 3), 6, seed=0)
+        r = run_darts_search(
+            ds, num_layers=2, init_channels=4, n_nodes=2, num_epochs=2,
+            batch_size=16, hyper=DartsHyper(unrolled=False),
+            native_prefetch=True,
+        )
+        assert len(r["history"]) == 2
+        assert {"epoch", "val_accuracy", "elapsed_s"} <= set(r["history"][0])
+        assert r["genotype"].normal and r["genotype"].reduce
