@@ -121,16 +121,35 @@ def run_darts_search(
             # (the a-half can be 1 longer when n is odd; an extra sample
             # would desync the C loaders' positional epoch boundaries)
             n_sync = len(x_w)
-            native_loaders = (
-                NativeBatchLoader(
-                    x_w, y_w, batch=batch_size, seed=seed,
-                    cache_path=os.path.join(loader_cache_dir, "w.bin"),
-                ),
-                NativeBatchLoader(
-                    x_a[:n_sync], y_a[:n_sync], batch=batch_size, seed=seed + 1,
-                    cache_path=os.path.join(loader_cache_dir, "a.bin"),
-                ),
-            )
+            built: list = []
+            try:
+                for xs_, ys_, sd, name in (
+                    (x_w, y_w, seed, "w.bin"),
+                    (x_a[:n_sync], y_a[:n_sync], seed + 1, "a.bin"),
+                ):
+                    built.append(
+                        NativeBatchLoader(
+                            xs_, ys_, batch=batch_size, seed=sd,
+                            cache_path=os.path.join(loader_cache_dir, name),
+                        )
+                    )
+                native_loaders = tuple(built)
+            except (RuntimeError, OSError) as e:
+                # prefetch is an optimization — a loader that can't start
+                # (batch > n, disk full, ...) falls back to the Python
+                # stream instead of failing the search
+                import shutil
+                import warnings
+
+                for dl in built:
+                    dl.close()
+                shutil.rmtree(loader_cache_dir, ignore_errors=True)
+                loader_cache_dir = None
+                warnings.warn(
+                    f"native prefetch unavailable ({e}); using Python batches",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
 
     best_acc = 0.0
     history = []

@@ -102,7 +102,6 @@ class NativeBatchLoader:
         if not self._h:
             raise RuntimeError(f"ktl_open failed for {cache_path}")
         self._record_bytes = record_bytes
-        self._buf = ctypes.create_string_buffer(batch * record_bytes)
 
     @property
     def batches_per_epoch(self) -> int:
@@ -111,12 +110,14 @@ class NativeBatchLoader:
     def epoch(self):
         """Yield this epoch's (x, y) batches (drop-last semantics)."""
         for _ in range(self.batches_per_epoch):
-            got = self._lib.ktl_next(self._h, self._buf)
+            # C++ gathers straight into this batch's numpy allocation —
+            # no intermediate staging buffer copy on the hot path
+            raw = np.empty((self.batch, self._record_bytes), dtype=np.uint8)
+            got = self._lib.ktl_next(
+                self._h, raw.ctypes.data_as(ctypes.c_char_p)
+            )
             if got != self.batch:
                 raise RuntimeError(f"native loader returned {got}")
-            raw = np.frombuffer(self._buf, dtype=np.uint8).reshape(
-                self.batch, self._record_bytes
-            )
             xb = (
                 raw[:, : self._x_bytes]
                 .copy()

@@ -6,10 +6,20 @@ Capability parity with the reference's ``bayesianoptimization`` service
 image; the GP comes from scikit-learn (same underlying model skopt wraps) and
 the acquisition loop is implemented here.
 
-Settings (mirroring the reference's accepted skopt settings):
+Settings (mirroring the reference's accepted skopt settings,
+``skopt/base_service.py:31-40``):
 - ``base_estimator``    only "GP" is supported
 - ``n_initial_points``  random-sample count before modeling (default 10)
-- ``acq_func``          "ei" (default) | "pi" | "lcb"
+- ``acq_func``          "ei" | "pi" | "lcb" | "gp_hedge" (case-insensitive;
+                        skopt spells them "EI"/"PI"/"LCB").  The reference
+                        default is gp_hedge — skopt's portfolio strategy:
+                        each acquisition proposes its best candidate, one is
+                        picked by softmax over accumulated gains, and every
+                        proposal's predicted mean is subtracted from its
+                        acquisition's gain so the portfolio adapts toward
+                        whichever acquisition proposes low-mean points.
+- ``acq_optimizer``     accepted for YAML compat ("auto"/"sampling"/"lbfgs");
+                        candidates are always optimized by sampling here
 - ``random_state``      seed
 """
 
@@ -22,7 +32,8 @@ from katib_tpu.core.types import Experiment, ExperimentSpec, TrialAssignmentSet
 from katib_tpu.suggest.base import Suggester, SuggesterError, register
 from katib_tpu.suggest.space import SpaceEncoder
 
-_ACQ_FUNCS = ("ei", "pi", "lcb")
+_ACQ_FUNCS = ("ei", "pi", "lcb", "gp_hedge")
+_ACQ_OPTIMIZERS = ("auto", "sampling", "lbfgs")
 
 
 @register("bayesianoptimization")
@@ -32,8 +43,10 @@ class BayesOptSuggester(Suggester):
         s = spec.algorithm.settings
         if s.get("base_estimator", "GP") != "GP":
             raise SuggesterError("only base_estimator=GP is supported")
-        if s.get("acq_func", "ei") not in _ACQ_FUNCS:
+        if s.get("acq_func", "ei").lower() not in _ACQ_FUNCS:
             raise SuggesterError(f"acq_func must be one of {_ACQ_FUNCS}")
+        if s.get("acq_optimizer", "auto").lower() not in _ACQ_OPTIMIZERS:
+            raise SuggesterError(f"acq_optimizer must be one of {_ACQ_OPTIMIZERS}")
         if "n_initial_points" in s and int(s["n_initial_points"]) < 1:
             raise SuggesterError("n_initial_points must be >= 1")
 
@@ -78,7 +91,7 @@ class BayesOptSuggester(Suggester):
         space = SpaceEncoder(self.spec.parameters)
         settings = self.spec.algorithm.settings
         n_init = int(settings.get("n_initial_points", 10))
-        acq = settings.get("acq_func", "ei")
+        acq = settings.get("acq_func", "ei").lower()
 
         xs, ys = self.observed_xy(experiment)
         rng = self.rng(extra=len(experiment.trials))
@@ -104,13 +117,31 @@ class BayesOptSuggester(Suggester):
         y = ys.copy()
         seed = self.seed(extra=len(experiment.trials))
         n_cand = 1024
+        hedge_gains = getattr(self, "_hedge_gains", None)
+        if hedge_gains is None:
+            hedge_gains = self._hedge_gains = np.zeros(3)
+        hedge_funcs = ("ei", "pi", "lcb")
         while len(out) < count:
             gp = self._fit_gp(X, y, seed)
             # candidate pool: random configurations in one-hot space
             cand_params = [space.sample(rng) for _ in range(n_cand)]
             X_cand = np.stack([space.encode_onehot(p) for p in cand_params])
-            scores = self._acquisition(gp, X_cand, float(np.min(y)), acq)
-            best = cand_params[int(np.argmax(scores))]
+            if acq == "gp_hedge":
+                # skopt portfolio: each acquisition nominates its argmax,
+                # selection is probability-matched on accumulated gains,
+                # and every nominee's predicted mean decrements its gain
+                picks = [
+                    int(np.argmax(self._acquisition(gp, X_cand, float(np.min(y)), a)))
+                    for a in hedge_funcs
+                ]
+                logits = hedge_gains - hedge_gains.max()
+                probs = np.exp(logits) / np.exp(logits).sum()
+                chosen = int(rng.choice(3, p=probs))
+                hedge_gains -= gp.predict(X_cand[picks])
+                best = cand_params[picks[chosen]]
+            else:
+                scores = self._acquisition(gp, X_cand, float(np.min(y)), acq)
+                best = cand_params[int(np.argmax(scores))]
             out.append(TrialAssignmentSet(assignments=space.to_assignments(best)))
             # hallucinate the GP mean at the chosen point (constant-liar) so a
             # batch of suggestions spreads out instead of stacking

@@ -44,12 +44,17 @@ def _truncnorm_pdf(x: np.ndarray, mu: float, sigma: float) -> np.ndarray:
 
 
 class _ParzenNumeric:
-    """1-D Parzen estimator over [0,1] with a uniform prior component."""
+    """1-D Parzen estimator over [0,1] with a uniform prior component.
 
-    def __init__(self, obs: np.ndarray):
+    ``prior_weight`` scales the uniform component against the (unit-weight)
+    observation kernels — the reference hyperopt setting of the same name
+    (``hyperopt/service.py:71``)."""
+
+    def __init__(self, obs: np.ndarray, prior_weight: float = 1.0):
         # observation ORDER is preserved: in multivariate mode component j must
         # be the same observation across every dimension
         self.mus = np.asarray(obs, dtype=np.float64)
+        self.prior_weight = float(prior_weight)
         n = len(self.mus)
         if n == 0:
             self.sigmas = np.array([])
@@ -68,10 +73,18 @@ class _ParzenNumeric:
     def sample(self, rng: np.random.Generator, n: int) -> np.ndarray:
         out = np.empty(n)
         k = len(self.mus)
+        w = self.prior_weight
         for i in range(n):
-            # prior component gets weight 1/(k+1)
-            j = rng.integers(k + 1)
-            if j == k:
+            # prior component gets weight w/(k+w), each kernel 1/(k+w).
+            # w == 1 uses the single-draw form so default-config runs keep
+            # their exact pre-prior_weight random streams (reproducibility)
+            if w == 1.0:
+                j = rng.integers(k + 1)
+                pick_prior = j == k
+            else:
+                pick_prior = rng.random() < w / (k + w)
+                j = rng.integers(k) if not pick_prior else k
+            if pick_prior:
                 out[i] = rng.random()
             else:
                 v = rng.normal(self.mus[j], self.sigmas[j])
@@ -82,10 +95,11 @@ class _ParzenNumeric:
         """Mixture density at x; uniform prior always contributes."""
         x = np.asarray(x, dtype=np.float64)
         k = len(self.mus)
-        total = np.ones_like(x)  # uniform prior component, pdf = 1 on [0,1]
+        w = self.prior_weight
+        total = np.full_like(x, w)  # uniform prior component, pdf = 1 on [0,1]
         for mu, s in zip(self.mus, self.sigmas):
             total = total + _truncnorm_pdf(x, mu, s)
-        return total / (k + 1)
+        return total / (k + w)
 
     def component_pdfs(self, x: np.ndarray) -> np.ndarray:
         """(k+1, len(x)) per-component densities (for multivariate joint)."""
@@ -134,11 +148,13 @@ class _TPECore:
         gamma: float,
         n_candidates: int,
         multivariate: bool,
+        prior_weight: float = 1.0,
     ):
         self.space = space
         self.gamma = gamma
         self.n_candidates = n_candidates
         self.multivariate = multivariate
+        self.prior_weight = float(prior_weight)
 
     def split(self, ys: np.ndarray) -> int:
         """Number of 'good' observations (lower y is better)."""
@@ -160,12 +176,18 @@ class _TPECore:
                 nc = self.space.n_choices(dim)
                 scale = max(nc - 1, 1)
                 good_est.append(
-                    _ParzenCategorical(np.round(good[:, dim] * scale), nc)
+                    _ParzenCategorical(
+                        np.round(good[:, dim] * scale), nc, prior=self.prior_weight
+                    )
                 )
-                bad_est.append(_ParzenCategorical(np.round(bad[:, dim] * scale), nc))
+                bad_est.append(
+                    _ParzenCategorical(
+                        np.round(bad[:, dim] * scale), nc, prior=self.prior_weight
+                    )
+                )
             else:
-                good_est.append(_ParzenNumeric(good[:, dim]))
-                bad_est.append(_ParzenNumeric(bad[:, dim]))
+                good_est.append(_ParzenNumeric(good[:, dim], self.prior_weight))
+                bad_est.append(_ParzenNumeric(bad[:, dim], self.prior_weight))
 
         # draw candidates from the good density
         cands = np.empty((self.n_candidates, d))
@@ -202,25 +224,41 @@ class _TPECore:
                 per_dim.append(est.component_pmfs(idx))
             else:
                 per_dim.append(est.component_pdfs(cands[:, dim]))
-        # (k+1, n_cands): product over dims within each component, mean over components
+        # (k+1, n_cands): product over dims within each component; weighted
+        # mean over components (row 0 = prior at prior_weight, kernels at 1)
         joint = np.ones_like(per_dim[0])
         for mat in per_dim:
             joint = joint * mat
-        return np.log(np.maximum(joint.mean(axis=0), 1e-300))
+        k = joint.shape[0] - 1
+        w = np.full(joint.shape[0], 1.0 / (k + self.prior_weight))
+        w[0] *= self.prior_weight
+        return np.log(np.maximum((joint * w[:, None]).sum(axis=0), 1e-300))
 
 
 class _BaseTPESuggester(Suggester):
     multivariate = False
+
+    # the reference spells this key ``n_EI_candidates``
+    # (``hyperopt/service.py:72``); accept both so Katib YAMLs round-trip
+    @staticmethod
+    def _ei_candidates_setting(s) -> str | None:
+        for key in ("n_EI_candidates", "n_ei_candidates"):
+            if key in s:
+                return s[key]
+        return None
 
     @classmethod
     def validate(cls, spec: ExperimentSpec) -> None:
         s = spec.algorithm.settings
         if "gamma" in s and not (0.0 < float(s["gamma"]) < 1.0):
             raise SuggesterError("gamma must be in (0, 1)")
-        if "n_ei_candidates" in s and int(s["n_ei_candidates"]) < 1:
-            raise SuggesterError("n_ei_candidates must be >= 1")
+        ei = cls._ei_candidates_setting(s)
+        if ei is not None and int(ei) < 1:
+            raise SuggesterError("n_EI_candidates must be >= 1")
         if "n_startup_trials" in s and int(s["n_startup_trials"]) < 0:
             raise SuggesterError("n_startup_trials must be >= 0")
+        if "prior_weight" in s and not float(s["prior_weight"]) > 0:
+            raise SuggesterError("prior_weight must be > 0")
 
     def get_suggestions(
         self, experiment: Experiment, count: int
@@ -229,7 +267,8 @@ class _BaseTPESuggester(Suggester):
         settings = self.spec.algorithm.settings
         n_startup = int(settings.get("n_startup_trials", 10))
         gamma = float(settings.get("gamma", 0.25))
-        n_cand = int(settings.get("n_ei_candidates", 24))
+        n_cand = int(self._ei_candidates_setting(settings) or 24)
+        prior_weight = float(settings.get("prior_weight", 1.0))
 
         xs, ys = self.observed_xy(experiment)
         rng = self.rng(extra=len(experiment.trials))
@@ -245,7 +284,7 @@ class _BaseTPESuggester(Suggester):
             if len(out) == count:
                 return out
 
-        core = _TPECore(space, gamma, n_cand, self.multivariate)
+        core = _TPECore(space, gamma, n_cand, self.multivariate, prior_weight)
         xs_enc = np.stack([space.encode(x) for x in xs]) if xs else np.zeros((0, space.n_dims))
         while len(out) < count:
             u = core.suggest_one(xs_enc, ys, rng)

@@ -281,3 +281,26 @@ class TestNativePrefetchSearch:
         assert len(r["history"]) == 2
         assert {"epoch", "val_accuracy", "elapsed_s"} <= set(r["history"][0])
         assert r["genotype"].normal and r["genotype"].reduce
+
+    def test_loader_failure_falls_back_to_python(self):
+        """A loader that can't start (batch > records) must degrade to the
+        Python stream with a warning, not fail the search."""
+        import warnings
+
+        from katib_tpu.models.data import synthetic_classification
+        from katib_tpu.nas.darts.architect import DartsHyper
+        from katib_tpu.nas.darts.search import run_darts_search
+        from katib_tpu.native import native_available
+
+        if not native_available():
+            pytest.skip("C++ toolchain unavailable")
+        ds = synthetic_classification(24, 16, (8, 8, 3), 4, seed=0)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            r = run_darts_search(
+                ds, num_layers=2, init_channels=4, n_nodes=2, num_epochs=1,
+                batch_size=16,  # > 12 records per half -> ktl_open rejects
+                hyper=DartsHyper(unrolled=False), native_prefetch=True,
+            )
+        assert any("native prefetch unavailable" in str(w.message) for w in caught)
+        assert r["genotype"] is not None

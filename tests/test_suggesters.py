@@ -191,6 +191,27 @@ class TestTPE:
     def test_settings_validation(self):
         with pytest.raises(SuggesterError):
             make_suggester(make_spec("tpe", settings={"gamma": "1.5"}))
+        with pytest.raises(SuggesterError):
+            make_suggester(make_spec("tpe", settings={"prior_weight": "0"}))
+
+    def test_reference_setting_spellings(self):
+        """Upstream Katib YAMLs spell the candidate-count key
+        ``n_EI_candidates`` (``hyperopt/service.py:72``) and may set
+        ``prior_weight``; both must be honored, not silently ignored."""
+        spec = make_spec(
+            "tpe",
+            settings={
+                "n_EI_candidates": "8",
+                "prior_weight": "2.0",
+                "n_startup_trials": "3",
+                "random_state": "5",
+            },
+        )
+        s = make_suggester(spec)
+        exp = run_loop(s, new_exp(spec), sphere, rounds=12)
+        assert best_value(exp) < 25.0  # the search actually ran
+        with pytest.raises(SuggesterError):
+            make_suggester(make_spec("tpe", settings={"n_EI_candidates": "0"}))
 
 
 class TestBayesOpt:
@@ -209,6 +230,28 @@ class TestBayesOpt:
             make_suggester(
                 make_spec("bayesianoptimization", settings={"base_estimator": "RF"})
             )
+        with pytest.raises(SuggesterError):
+            make_suggester(
+                make_spec("bayesianoptimization", settings={"acq_optimizer": "nope"})
+            )
+
+    def test_gp_hedge_and_skopt_spellings(self):
+        """The reference defaults to acq_func=gp_hedge and skopt spells the
+        functions upper-case; both must work (``skopt/base_service.py:33``)."""
+        spec = make_spec(
+            "bayesianoptimization",
+            settings={
+                "acq_func": "gp_hedge",
+                "acq_optimizer": "auto",
+                "n_initial_points": "5",
+                "random_state": "2",
+            },
+        )
+        exp = run_loop(make_suggester(spec), new_exp(spec), sphere, rounds=15)
+        assert best_value(exp) < 5.0
+        make_suggester(
+            make_spec("bayesianoptimization", settings={"acq_func": "LCB"})
+        )  # case-insensitive accept
 
     def test_categorical_support(self):
         spec = make_spec(
