@@ -72,10 +72,12 @@ class BayesOptSuggester(Suggester):
             gp.fit(X, y)
         return gp
 
-    def _acquisition(
-        self, gp, X_cand: np.ndarray, y_best: float, acq: str, xi: float = 0.01
+    @staticmethod
+    def _scores(
+        mu: np.ndarray, sigma: np.ndarray, y_best: float, acq: str, xi: float = 0.01
     ) -> np.ndarray:
-        mu, sigma = gp.predict(X_cand, return_std=True)
+        """Acquisition scores from a shared GP posterior (one ``predict``
+        serves every acquisition — gp_hedge needs all three per ask)."""
         sigma = np.maximum(sigma, 1e-9)
         if acq == "lcb":
             return -(mu - 1.96 * sigma)  # maximize negative lower bound
@@ -85,13 +87,28 @@ class BayesOptSuggester(Suggester):
             return norm.cdf(z)
         return imp * norm.cdf(z) + sigma * norm.pdf(z)  # EI
 
+    # -- gp_hedge portfolio state: call-history state, so it must ride the
+    # resume hooks (base contract: everything else derives from trial
+    # history; these pickles restore the adaptive portfolio on --resume)
+
+    def state_dict(self) -> dict:
+        return {"hedge_gains": list(getattr(self, "_hedge_gains", np.zeros(3)))}
+
+    def load_state_dict(self, data: dict) -> None:
+        gains = data.get("hedge_gains")
+        if gains is not None and len(gains) == 3:
+            self._hedge_gains = np.asarray(gains, dtype=float)
+
     def get_suggestions(
         self, experiment: Experiment, count: int
     ) -> list[TrialAssignmentSet]:
         space = SpaceEncoder(self.spec.parameters)
         settings = self.spec.algorithm.settings
         n_init = int(settings.get("n_initial_points", 10))
-        acq = settings.get("acq_func", "ei").lower()
+        # default matches the reference service's skopt default (gp_hedge,
+        # ``skopt/base_service.py:33``) so an acq-less Katib YAML behaves
+        # the same here as upstream
+        acq = settings.get("acq_func", "gp_hedge").lower()
 
         xs, ys = self.observed_xy(experiment)
         rng = self.rng(extra=len(experiment.trials))
@@ -126,22 +143,26 @@ class BayesOptSuggester(Suggester):
             # candidate pool: random configurations in one-hot space
             cand_params = [space.sample(rng) for _ in range(n_cand)]
             X_cand = np.stack([space.encode_onehot(p) for p in cand_params])
+            # one posterior evaluation serves every acquisition below
+            mu, sigma = gp.predict(X_cand, return_std=True)
+            y_best = float(np.min(y))
             if acq == "gp_hedge":
                 # skopt portfolio: each acquisition nominates its argmax,
                 # selection is probability-matched on accumulated gains,
                 # and every nominee's predicted mean decrements its gain
                 picks = [
-                    int(np.argmax(self._acquisition(gp, X_cand, float(np.min(y)), a)))
+                    int(np.argmax(self._scores(mu, sigma, y_best, a)))
                     for a in hedge_funcs
                 ]
                 logits = hedge_gains - hedge_gains.max()
                 probs = np.exp(logits) / np.exp(logits).sum()
                 chosen = int(rng.choice(3, p=probs))
-                hedge_gains -= gp.predict(X_cand[picks])
+                hedge_gains -= mu[picks]
                 best = cand_params[picks[chosen]]
             else:
-                scores = self._acquisition(gp, X_cand, float(np.min(y)), acq)
-                best = cand_params[int(np.argmax(scores))]
+                best = cand_params[
+                    int(np.argmax(self._scores(mu, sigma, y_best, acq)))
+                ]
             out.append(TrialAssignmentSet(assignments=space.to_assignments(best)))
             # hallucinate the GP mean at the chosen point (constant-liar) so a
             # batch of suggestions spreads out instead of stacking
