@@ -34,6 +34,8 @@ from katib_tpu.suggest.space import SpaceEncoder
 
 _ACQ_FUNCS = ("ei", "pi", "lcb", "gp_hedge")
 _ACQ_OPTIMIZERS = ("auto", "sampling", "lbfgs")
+# the reference service's skopt default (``skopt/base_service.py:33``)
+_DEFAULT_ACQ = "gp_hedge"
 
 
 @register("bayesianoptimization")
@@ -43,7 +45,7 @@ class BayesOptSuggester(Suggester):
         s = spec.algorithm.settings
         if s.get("base_estimator", "GP") != "GP":
             raise SuggesterError("only base_estimator=GP is supported")
-        if s.get("acq_func", "ei").lower() not in _ACQ_FUNCS:
+        if s.get("acq_func", _DEFAULT_ACQ).lower() not in _ACQ_FUNCS:
             raise SuggesterError(f"acq_func must be one of {_ACQ_FUNCS}")
         if s.get("acq_optimizer", "auto").lower() not in _ACQ_OPTIMIZERS:
             raise SuggesterError(f"acq_optimizer must be one of {_ACQ_OPTIMIZERS}")
@@ -108,7 +110,7 @@ class BayesOptSuggester(Suggester):
         # default matches the reference service's skopt default (gp_hedge,
         # ``skopt/base_service.py:33``) so an acq-less Katib YAML behaves
         # the same here as upstream
-        acq = settings.get("acq_func", "gp_hedge").lower()
+        acq = settings.get("acq_func", _DEFAULT_ACQ).lower()
 
         xs, ys = self.observed_xy(experiment)
         rng = self.rng(extra=len(experiment.trials))

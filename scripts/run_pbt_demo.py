@@ -27,8 +27,6 @@ def main() -> int:
         force_platform=os.environ.get("PBT_PLATFORM", "cpu"), virtual_devices=8
     )
 
-    import tempfile
-
     from katib_tpu.core.types import (
         AlgorithmSpec,
         ExperimentSpec,
@@ -43,7 +41,9 @@ def main() -> int:
 
     population = int(os.environ.get("PBT_POPULATION", "8"))
     generations = int(os.environ.get("PBT_GENERATIONS", "5"))
-    ckpt_dir = tempfile.mkdtemp(prefix="pbt-demo-ckpts-")
+    # lineage lives under the experiment workdir (durable across --resume,
+    # not a leaked tempdir)
+    ckpt_dir = os.path.join(REPO, "katib_runs", "pbt-demo", "pbt-lineage")
 
     spec = ExperimentSpec(
         name="pbt-demo",

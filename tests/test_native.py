@@ -267,3 +267,29 @@ class TestNativeBatchLoader:
             NativeBatchLoader(
                 x, y, batch=8, seed=0, cache_path=str(tmp_path / "d.bin")
             )
+
+
+class TestThreadSanitizer:
+    """Race detection for the concurrent native components — the reference
+    runs its suite without -race (SURVEY §5); here the store and loader are
+    hammered under TSan (``native/src/stress.cc``, ``make tsan``)."""
+
+    def test_stress_binary_clean_under_tsan(self, tmp_path):
+        import subprocess
+
+        from katib_tpu.native.build import _DIR
+
+        build = subprocess.run(
+            ["make", "tsan"], cwd=_DIR, capture_output=True, text=True
+        )
+        if build.returncode != 0:
+            pytest.skip(f"tsan build unavailable: {build.stderr[-300:]}")
+        run = subprocess.run(
+            [f"{_DIR}/build/katib-native-stress", str(tmp_path)],
+            capture_output=True, text=True, timeout=240,
+        )
+        assert run.returncode == 0, (
+            f"TSan reported races or stress failed:\n{run.stdout[-500:]}"
+            f"\n{run.stderr[-2000:]}"
+        )
+        assert "native stress: PASS" in run.stdout
