@@ -165,6 +165,64 @@ def _parse_nas_config(raw: Mapping[str, Any] | None) -> NasConfig | None:
     return NasConfig(graph_config=graph, operations=operations)
 
 
+def _find_containers(node: Any) -> list:
+    """Collect EVERY ``containers`` list inside an arbitrary K8s manifest
+    (Job, TFJob, PyTorchJob... all nest pod templates differently — the
+    reference's trial job is an arbitrary GVK, ``trial_types.go:42``).  All
+    of them, not the first: a multi-replica TFJob's primary container can
+    live in any replica's pod template."""
+    out: list = []
+    if isinstance(node, Mapping):
+        got = node.get("containers")
+        if isinstance(got, list):
+            out.extend(c for c in got if isinstance(c, Mapping))
+        for v in node.values():
+            out.extend(_find_containers(v))
+    elif isinstance(node, list):
+        for v in node:
+            out.extend(_find_containers(v))
+    return out
+
+
+def _command_from_trial_spec(template: Mapping[str, Any]) -> list[str] | None:
+    """Extract the primary container's argv from a reference-style nested
+    ``trialTemplate.trialSpec`` (K8s Job manifest) and rewrite its
+    ``${trialParameters.<name>}`` placeholders to the experiment parameter
+    each trialParameter references — the loader-side analog of the
+    reference's manifest generator substitution (``manifest/generator.go:
+    79-126``), so an unmodified Katib CR round-trips (the container image
+    itself does not transfer; the user points the argv at a local trainer).
+    """
+    containers = _find_containers(template.get("trialSpec"))
+    if not containers:
+        return None
+    primary = template.get("primaryContainerName")
+    container = None
+    if primary:
+        container = next((c for c in containers if c.get("name") == primary), None)
+    if container is None:
+        container = containers[0]
+    argv = list(container.get("command") or []) + list(container.get("args") or [])
+    if not argv:
+        return None
+    renames = {
+        str(tp["name"]): str(tp["reference"])
+        for tp in template.get("trialParameters") or ()
+        if isinstance(tp, Mapping) and tp.get("name") and tp.get("reference")
+    }
+    # single simultaneous pass: sequential str.replace would chain when one
+    # trialParameter's reference is another trialParameter's name
+    import re
+
+    pattern = re.compile(r"\$\{trialParameters\.([^}]+)\}")
+
+    def rewrite(m: "re.Match[str]") -> str:
+        name = m.group(1)
+        return "${trialParameters." + renames.get(name, name) + "}"
+
+    return [pattern.sub(rewrite, str(token)) for token in argv]
+
+
 def experiment_spec_from_dict(data: Mapping[str, Any]) -> ExperimentSpec:
     """Build an ExperimentSpec from a CR-shaped or flat mapping."""
     if "spec" in data:  # CR shape
@@ -197,11 +255,31 @@ def experiment_spec_from_dict(data: Mapping[str, Any]) -> ExperimentSpec:
 
     # trialTemplate: only the command argv carries over (the reference's
     # ${trialParameters.X} placeholders work unchanged); K8s job fields are
-    # meaningless here
+    # meaningless here.  A full reference CR with a nested K8s Job trialSpec
+    # also loads: the primary container's argv is extracted and its
+    # trialParameter names rewritten to the parameter names they reference.
     command = spec.get("command")
     template = spec.get("trialTemplate") or {}
     if command is None:
         command = template.get("command")
+    if command is None and template.get("trialSpec"):
+        command = _command_from_trial_spec(template)
+
+    # white-box trials from YAML: ``trialTemplate.trainFn`` names a dotted
+    # import path to a ``train_fn(ctx)`` (e.g. the packaged workloads in
+    # models/ and nas/) — the CR analog of passing train_fn in Python
+    train_fn = None
+    train_fn_path = template.get("trainFn") or spec.get("trainFn")
+    if train_fn_path:
+        import importlib
+
+        mod_name, _, attr = str(train_fn_path).rpartition(".")
+        if not mod_name:
+            raise SpecError(f"trainFn {train_fn_path!r} must be module.attr")
+        try:
+            train_fn = getattr(importlib.import_module(mod_name), attr)
+        except (ImportError, AttributeError) as e:
+            raise SpecError(f"trainFn {train_fn_path!r} not importable: {e}") from e
 
     resume = spec.get("resumePolicy", "Never")
     try:
@@ -227,6 +305,7 @@ def experiment_spec_from_dict(data: Mapping[str, Any]) -> ExperimentSpec:
         resume_policy=resume_policy,
         metrics_collector=_parse_collector(spec.get("metricsCollectorSpec")),
         command=[str(c) for c in command] if command else None,
+        train_fn=train_fn,
         nas_config=_parse_nas_config(spec.get("nasConfig")),
         retain=bool(spec.get("retain", template.get("retain", False))),
         max_trial_runtime_seconds=(

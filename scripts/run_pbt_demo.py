@@ -39,8 +39,11 @@ def main() -> int:
     from katib_tpu.models.pbt_toy import pbt_toy_trial
     from katib_tpu.orchestrator import Orchestrator
 
+    # score accrues ~0.02/step along a lineage, so the evolution curve only
+    # becomes unmistakable with enough generations for exploit/explore to
+    # compound — 8 generations gives surviving lineages room to separate
     population = int(os.environ.get("PBT_POPULATION", "8"))
-    generations = int(os.environ.get("PBT_GENERATIONS", "5"))
+    generations = int(os.environ.get("PBT_GENERATIONS", "8"))
     # lineage lives under the experiment workdir (durable across --resume,
     # not a leaked tempdir)
     ckpt_dir = os.path.join(REPO, "katib_runs", "pbt-demo", "pbt-lineage")
