@@ -2,11 +2,12 @@
 
 Accepts the reference's Experiment CR shape (``apiVersion: kubeflow.org/...``
 ``kind: Experiment`` with ``metadata.name`` + ``spec.{objective, algorithm,
-parameters, ...}`` — see ``examples/v1beta1/hp-tuning/random.yaml``) so
-existing Katib experiment files port with only the trialTemplate swapped for
-a ``command`` argv, plus an equivalent flat shape for new users.  Trials
-defined this way are black-box subprocess commands; white-box JAX ``train_fn``
-experiments are built in Python via the SDK.
+parameters, ...}`` — see ``examples/v1beta1/hp-tuning/random.yaml``), so an
+unmodified Katib CR loads: a nested K8s ``trialTemplate.trialSpec`` has its
+primary container's argv extracted with trialParameter placeholders
+rewritten, or the template carries a flat ``command`` argv directly.
+White-box JAX trials come from ``trialTemplate.trainFn`` (a dotted import
+path to a ``train_fn(ctx)``) or by setting ``train_fn`` via the SDK.
 """
 
 from __future__ import annotations
@@ -197,10 +198,15 @@ def _command_from_trial_spec(template: Mapping[str, Any]) -> list[str] | None:
     if not containers:
         return None
     primary = template.get("primaryContainerName")
-    container = None
     if primary:
         container = next((c for c in containers if c.get("name") == primary), None)
-    if container is None:
+        if container is None:
+            # a silent containers[0] fallback would extract a sidecar's argv
+            raise SpecError(
+                f"primaryContainerName {primary!r} matches no container in "
+                f"trialSpec (found: {[c.get('name') for c in containers]})"
+            )
+    else:
         container = containers[0]
     argv = list(container.get("command") or []) + list(container.get("args") or [])
     if not argv:
