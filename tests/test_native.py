@@ -274,22 +274,26 @@ class TestThreadSanitizer:
     runs its suite without -race (SURVEY §5); here the store and loader are
     hammered under TSan (``native/src/stress.cc``, ``make tsan``)."""
 
-    def test_stress_binary_clean_under_tsan(self, tmp_path):
+    @pytest.mark.parametrize(
+        "target,binary",
+        [("tsan", "katib-native-stress"), ("asan", "katib-native-stress-asan")],
+    )
+    def test_stress_binary_clean_under_sanitizer(self, tmp_path, target, binary):
         import subprocess
 
         from katib_tpu.native.build import _DIR
 
         build = subprocess.run(
-            ["make", "tsan"], cwd=_DIR, capture_output=True, text=True
+            ["make", target], cwd=_DIR, capture_output=True, text=True
         )
         if build.returncode != 0:
-            pytest.skip(f"tsan build unavailable: {build.stderr[-300:]}")
+            pytest.skip(f"{target} build unavailable: {build.stderr[-300:]}")
         run = subprocess.run(
-            [f"{_DIR}/build/katib-native-stress", str(tmp_path)],
+            [f"{_DIR}/build/{binary}", str(tmp_path)],
             capture_output=True, text=True, timeout=240,
         )
         assert run.returncode == 0, (
-            f"TSan reported races or stress failed:\n{run.stdout[-500:]}"
+            f"sanitizer reported problems or stress failed:\n{run.stdout[-500:]}"
             f"\n{run.stderr[-2000:]}"
         )
         assert "native stress: PASS" in run.stdout
