@@ -663,6 +663,11 @@ class Experiment:
     # Mutable algorithm settings (Hyperband state lives here; reference
     # round-trips it via Suggestion.Status.AlgorithmSettings).
     algorithm_settings: dict[str, str] = field(default_factory=dict)
+    # best-objective@wallclock: one row per improvement of the optimal
+    # trial ({time, elapsed_s, objective_value, trial_name}) — the BASELINE
+    # driver metric, journaled with the status so every experiment carries
+    # its own convergence curve
+    optimal_history: list[dict] = field(default_factory=list)
 
     def __post_init__(self) -> None:
         if not self.algorithm_settings:
@@ -720,6 +725,28 @@ class Experiment:
                     observation=t.observation or Observation(),
                 )
         self.optimal = best
+        if best is not None:
+            last = self.optimal_history[-1] if self.optimal_history else None
+            if (
+                last is None
+                or last["objective_value"] != best.objective_value
+                or last["trial_name"] != best.trial_name
+            ):
+                now = time.time()
+                # a recompute AFTER completion (e.g. resuming an old journal
+                # that predates the curve) must not charge process downtime
+                # to the curve: the run's own clock ends at completion_time
+                clock = now
+                if self.completion_time and self.condition.is_terminal():
+                    clock = min(now, self.completion_time)
+                self.optimal_history.append(
+                    {
+                        "time": now,
+                        "elapsed_s": round(max(clock - self.start_time, 0.0), 3),
+                        "objective_value": best.objective_value,
+                        "trial_name": best.trial_name,
+                    }
+                )
 
 
 def clone_with(obj: Any, **changes: Any) -> Any:

@@ -133,6 +133,11 @@ def experiment_from_dict(spec: ExperimentSpec, status: dict) -> Experiment:
     )
     if status.get("algorithm_settings"):
         exp.algorithm_settings = dict(status["algorithm_settings"])
+    # restore the convergence curve BEFORE recomputing the optimal, so the
+    # recompute extends the journaled history instead of restarting it
+    exp.optimal_history = [
+        dict(row) for row in status.get("optimal_history") or ()
+    ]
     for name, tdata in (status.get("trials") or {}).items():
         exp.trials[name] = trial_from_dict(spec, tdata)
     exp.update_optimal()

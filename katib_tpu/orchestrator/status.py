@@ -74,6 +74,8 @@ def experiment_to_dict(exp: Experiment) -> dict:
                 "assignments": {a.name: a.value for a in exp.optimal.assignments},
             }
         ),
+        # best-objective@wallclock rows (the BASELINE driver metric)
+        "optimal_history": list(exp.optimal_history),
         "trials": {name: trial_to_dict(t) for name, t in exp.trials.items()},
     }
 

@@ -503,12 +503,28 @@ async function refresh(){
 }
 document.getElementById('submit').onclick=()=>
   act('/api/experiments','POST',JSON.stringify({yaml:document.getElementById('yaml').value}));
+function sparkline(rows){
+  if(!rows||!rows.length)return '';
+  const xs=rows.map(r=>r.elapsed_s),ys=rows.map(r=>r.objective_value);
+  const last=`best objective vs wallclock (${esc(ys[ys.length-1].toFixed?.(5)??ys[ys.length-1])} @ ${esc(xs[xs.length-1])}s)`;
+  const W=260,H=48;
+  if(rows.length<2)
+    return `<div><small>${last}</small><br><svg width="${W}" height="${H}"><circle cx="8" cy="${H/2}" r="3" fill="#2a7"/></svg></div>`;
+  const x0=Math.min(...xs),x1=Math.max(...xs)||1,y0=Math.min(...ys),y1=Math.max(...ys);
+  const px=v=>4+(W-8)*(v-x0)/((x1-x0)||1),py=v=>H-4-(H-8)*(v-y0)/((y1-y0)||1);
+  const pts=rows.map(r=>px(r.elapsed_s)+','+py(r.objective_value)).join(' ');
+  return `<div><small>${last}</small><br>`+
+    `<svg width="${W}" height="${H}"><polyline points="${pts}" fill="none" stroke="#2a7" stroke-width="2"/></svg></div>`;
+}
 async function show(name,re=true){
   current=name;
-  const t=await j('/api/experiment/'+encodeURIComponent(name)+'/trials');
+  const [st,t]=await Promise.all([
+    j('/api/experiment/'+encodeURIComponent(name)),
+    j('/api/experiment/'+encodeURIComponent(name)+'/trials')]);
   const cols=[...new Set(t.flatMap(r=>Object.keys(r.metrics||{})))];
   const pcols=[...new Set(t.flatMap(r=>Object.keys(r.assignments||{})))];
   document.getElementById('detail').innerHTML=
+    sparkline(st.optimal_history)+
     `<h2>${esc(name)} — trials</h2><table><thead><tr><th>trial</th><th>status</th>`+
     pcols.map(p=>`<th>${esc(p)}</th>`).join('')+cols.map(c=>`<th>${esc(c)}</th>`).join('')+
     `</tr></thead><tbody>`+t.map(r=>`<tr><td>${esc(r.name)}</td><td>${badge(r.condition)}</td>`+

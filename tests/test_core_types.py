@@ -208,6 +208,23 @@ class TestExperimentStatus:
         assert exp.optimal.trial_name == "b"
         assert exp.optimal.objective_value == 0.9
 
+    def test_optimal_history_curve(self):
+        """best-objective@wallclock: one row per improvement, idempotent
+        under recompute (the BASELINE driver metric)."""
+        exp = Experiment(spec=make_spec())
+        exp.trials["a"] = self._trial("a", TrialCondition.SUCCEEDED, 0.8)
+        exp.update_optimal()
+        exp.update_optimal()  # no change -> no duplicate row
+        assert [r["objective_value"] for r in exp.optimal_history] == [0.8]
+        exp.trials["b"] = self._trial("b", TrialCondition.SUCCEEDED, 0.7)
+        exp.update_optimal()  # worse trial -> optimal unchanged -> no row
+        assert len(exp.optimal_history) == 1
+        exp.trials["c"] = self._trial("c", TrialCondition.SUCCEEDED, 0.95)
+        exp.update_optimal()
+        assert [r["objective_value"] for r in exp.optimal_history] == [0.8, 0.95]
+        assert exp.optimal_history[-1]["trial_name"] == "c"
+        assert exp.optimal_history[-1]["elapsed_s"] >= 0
+
     def test_counts(self):
         exp = Experiment(spec=make_spec())
         exp.trials["a"] = self._trial("a", TrialCondition.SUCCEEDED, 0.8)

@@ -108,6 +108,18 @@ class TestJournalRoundTrip:
         orch = Orchestrator(workdir=str(tmp_path))
         assert orch.load_experiment(make_spec(name="ghost")) is None
 
+    def test_optimal_history_survives_round_trip(self, tmp_path):
+        """The journaled convergence curve is restored verbatim and the
+        post-load recompute extends it rather than restarting it."""
+        spec = make_spec(name="curve-exp")
+        exp = Orchestrator(workdir=str(tmp_path)).run(spec)
+        assert exp.optimal_history, "a completed run must have curve rows"
+        status = read_status(str(tmp_path), "curve-exp")
+        assert status["optimal_history"] == exp.optimal_history
+        rebuilt = experiment_from_dict(spec, status)
+        # recompute found the same optimal -> same rows, no restart/dupe
+        assert rebuilt.optimal_history == exp.optimal_history
+
 
 class TestOrphanResubmission:
     def test_orphaned_trial_reruns_under_original_name(self, tmp_path):
