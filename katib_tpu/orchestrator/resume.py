@@ -141,6 +141,15 @@ def experiment_from_dict(spec: ExperimentSpec, status: dict) -> Experiment:
     for name, tdata in (status.get("trials") or {}).items():
         exp.trials[name] = trial_from_dict(spec, tdata)
     exp.update_optimal()
+    if not status.get("optimal_history") and exp.optimal_history:
+        # pre-curve journal: the row just appended was clocked at load time,
+        # charging process downtime; re-anchor it to the optimal trial's own
+        # completion time (the best information the old journal carries)
+        best_trial = exp.trials.get(exp.optimal_history[-1]["trial_name"])
+        if best_trial is not None and best_trial.completion_time:
+            exp.optimal_history[-1]["elapsed_s"] = round(
+                max(best_trial.completion_time - exp.start_time, 0.0), 3
+            )
     # sanity: journal's recorded optimal should agree; recompute wins because
     # it is derived from the same trial set
     if exp.optimal is None and status.get("optimal"):

@@ -75,16 +75,74 @@ print(f"RESULT pid={{pid}} loss={{loss:.10f}} w0={{w0:.10f}}", flush=True)
 """
 
 
+RING_WORKER = """
+import os, sys
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from katib_tpu.parallel.distributed import initialize_distributed
+from katib_tpu.parallel.mesh import SEQ_AXIS, make_mesh
+from katib_tpu.parallel.ring_attention import (
+    make_sequence_parallel_attention,
+    reference_attention_with_lse,
+)
+
+pid = int(sys.argv[1]); port = sys.argv[2]
+assert initialize_distributed(f"127.0.0.1:{{port}}", 2, pid)
+assert jax.device_count() == 4
+
+# sequence axis spans BOTH processes: ppermute K/V rotation crosses the
+# process boundary (the DCN leg of the v5e multi-host story)
+mesh = make_mesh({{SEQ_AXIS: 4}})
+B, H, S, D = 1, 2, 32, 8
+
+# identical global tensors on both processes (same seed)
+rng = np.random.RandomState(0)
+q = rng.randn(B, H, S, D).astype(np.float32)
+k = rng.randn(B, H, S, D).astype(np.float32)
+v = rng.randn(B, H, S, D).astype(np.float32)
+
+sharding = NamedSharding(mesh, PartitionSpec(None, None, SEQ_AXIS, None))
+local_slice = lambda a: a[:, :, pid * (S // 2):(pid + 1) * (S // 2), :]
+qg = jax.make_array_from_process_local_data(sharding, local_slice(q), (B, H, S, D))
+kg = jax.make_array_from_process_local_data(sharding, local_slice(k), (B, H, S, D))
+vg = jax.make_array_from_process_local_data(sharding, local_slice(v), (B, H, S, D))
+
+attn = make_sequence_parallel_attention(mesh, strategy="ring", causal=True)
+out = jax.jit(attn)(qg, kg, vg)
+
+dense, _ = reference_attention_with_lse(
+    jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=True
+)
+dense = np.asarray(dense)
+
+# each process checks its OWN addressable shards against the dense slice
+for shard in out.addressable_shards:
+    s0 = shard.index[2].start or 0
+    got = np.asarray(shard.data)
+    want = dense[:, :, s0:s0 + got.shape[2], :]
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+print(f"RESULT pid={{pid}} ok=1 shards={{len(out.addressable_shards)}}", flush=True)
+"""
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
 
 
-def test_two_process_data_parallel_step(tmp_path):
+def _run_pair(tmp_path, source, timeout=150):
     port = _free_port()
     script = tmp_path / "worker.py"
-    script.write_text(WORKER.format(repo=REPO))
+    script.write_text(source.format(repo=REPO))
     procs = [
         subprocess.Popen(
             [sys.executable, str(script), str(pid), str(port)],
@@ -98,7 +156,7 @@ def test_two_process_data_parallel_step(tmp_path):
     outs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=150)
+            out, _ = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
@@ -111,8 +169,24 @@ def test_two_process_data_parallel_step(tmp_path):
         for line in out.splitlines():
             if line.startswith("RESULT"):
                 parts = dict(kv.split("=") for kv in line.split()[1:])
-                results[parts["pid"]] = (parts["loss"], parts["w0"])
+                results[parts["pid"]] = parts
+    return results
+
+
+def test_two_process_ring_attention_matches_dense(tmp_path):
+    """Ring attention with the sequence axis spanning two processes: the
+    ppermute K/V rotation crosses the process boundary (the DCN leg), and
+    every process's output shards must match the dense reference."""
+    results = _run_pair(tmp_path, RING_WORKER, timeout=180)
+    assert set(results) == {"0", "1"}
+    assert all(r["ok"] == "1" for r in results.values())
+
+
+def test_two_process_data_parallel_step(tmp_path):
+    results = _run_pair(tmp_path, WORKER)
     assert set(results) == {"0", "1"}
     # SPMD consistency: both processes computed identical global loss and
     # identical post-update (all-reduced) weights
-    assert results["0"] == results["1"]
+    assert (results["0"]["loss"], results["0"]["w0"]) == (
+        results["1"]["loss"], results["1"]["w0"]
+    )
