@@ -138,6 +138,18 @@ def cmd_describe(args: argparse.Namespace) -> int:
             f"Optimal:    {optimal['trial_name']} -> {optimal['objective_value']}  "
             + " ".join(f"{k}={v}" for k, v in sorted(optimal["assignments"].items()))
         )
+    curve = s.get("optimal_history") or []
+    if curve:
+        # best-objective@wallclock, most recent improvements last
+        shown = curve[-5:]
+        prefix = "…, " if len(curve) > 5 else ""
+        print(
+            "Converge:   "
+            + prefix
+            + ", ".join(
+                f"{r['objective_value']:.5g}@{r['elapsed_s']:.0f}s" for r in shown
+            )
+        )
     rows = []
     for t in s.get("trials", {}).values():
         obs = t.get("observation") or []
