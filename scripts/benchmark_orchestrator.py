@@ -28,8 +28,6 @@ def main() -> int:
 
     import tempfile
 
-    from contextlib import ExitStack
-
     from katib_tpu.core.types import (
         AlgorithmSpec,
         ExperimentSpec,
@@ -62,12 +60,10 @@ def main() -> int:
         parallel_trial_count=16,
         train_fn=train,
     )
-    stack = ExitStack()
     t0 = time.perf_counter()
-    exp = Orchestrator(
-        workdir=stack.enter_context(tempfile.TemporaryDirectory())
-    ).run(spec)
-    dt = time.perf_counter() - t0
+    with tempfile.TemporaryDirectory() as wd:
+        exp = Orchestrator(workdir=wd).run(spec)
+        dt = time.perf_counter() - t0
     assert exp.succeeded_count == n_white, exp.succeeded_count
     results["whitebox"] = {
         "trials": n_white,
@@ -97,10 +93,9 @@ def main() -> int:
         metrics_collector=MetricsCollectorSpec(kind=MetricsCollectorKind.STDOUT),
     )
     t0 = time.perf_counter()
-    exp_b = Orchestrator(
-        workdir=stack.enter_context(tempfile.TemporaryDirectory())
-    ).run(spec_b)
-    dt_b = time.perf_counter() - t0
+    with tempfile.TemporaryDirectory() as wd:
+        exp_b = Orchestrator(workdir=wd).run(spec_b)
+        dt_b = time.perf_counter() - t0
     assert exp_b.succeeded_count == n_black, exp_b.succeeded_count
     results["blackbox"] = {
         "trials": n_black,
@@ -109,7 +104,6 @@ def main() -> int:
         "trials_per_hour": round(n_black / dt_b * 3600.0, 0),
         "amortized_ms_per_trial": round(dt_b / n_black * 1000.0, 2),
     }
-    stack.close()
     # context: the reference's CI bound is <=40 MINUTES per e2e experiment
     # of ~12 trials (run-e2e-experiment.py:11) — minutes/trial, not ms
     results["reference_context"] = (

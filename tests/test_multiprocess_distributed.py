@@ -95,13 +95,15 @@ from katib_tpu.parallel.ring_attention import (
 )
 
 pid = int(sys.argv[1]); port = sys.argv[2]
+strategy = sys.argv[3] if len(sys.argv) > 3 else "ring"
 assert initialize_distributed(f"127.0.0.1:{{port}}", 2, pid)
 assert jax.device_count() == 4
 
-# sequence axis spans BOTH processes: ppermute K/V rotation crosses the
-# process boundary (the DCN leg of the v5e multi-host story)
+# sequence axis spans BOTH processes: the collective (ppermute K/V rotation
+# for ring, all-to-all head scatter for ulysses) crosses the process
+# boundary — the DCN leg of the v5e multi-host story
 mesh = make_mesh({{SEQ_AXIS: 4}})
-B, H, S, D = 1, 2, 32, 8
+B, H, S, D = 1, 4, 32, 8
 
 # identical global tensors on both processes (same seed)
 rng = np.random.RandomState(0)
@@ -115,7 +117,7 @@ qg = jax.make_array_from_process_local_data(sharding, local_slice(q), (B, H, S, 
 kg = jax.make_array_from_process_local_data(sharding, local_slice(k), (B, H, S, D))
 vg = jax.make_array_from_process_local_data(sharding, local_slice(v), (B, H, S, D))
 
-attn = make_sequence_parallel_attention(mesh, strategy="ring", causal=True)
+attn = make_sequence_parallel_attention(mesh, strategy=strategy, causal=True)
 out = jax.jit(attn)(qg, kg, vg)
 
 dense, _ = reference_attention_with_lse(
@@ -139,13 +141,13 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_pair(tmp_path, source, timeout=150):
+def _run_pair(tmp_path, source, timeout=150, extra_args=()):
     port = _free_port()
     script = tmp_path / "worker.py"
     script.write_text(source.format(repo=REPO))
     procs = [
         subprocess.Popen(
-            [sys.executable, str(script), str(pid), str(port)],
+            [sys.executable, str(script), str(pid), str(port), *extra_args],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
@@ -173,11 +175,13 @@ def _run_pair(tmp_path, source, timeout=150):
     return results
 
 
-def test_two_process_ring_attention_matches_dense(tmp_path):
-    """Ring attention with the sequence axis spanning two processes: the
-    ppermute K/V rotation crosses the process boundary (the DCN leg), and
-    every process's output shards must match the dense reference."""
-    results = _run_pair(tmp_path, RING_WORKER, timeout=180)
+@pytest.mark.parametrize("strategy", ["ring", "ulysses"])
+def test_two_process_sequence_parallel_matches_dense(tmp_path, strategy):
+    """Sequence parallelism with the seq axis spanning two processes: the
+    collective (ppermute for ring, all-to-all for ulysses) crosses the
+    process boundary, and every process's output shards must match the
+    dense reference."""
+    results = _run_pair(tmp_path, RING_WORKER, timeout=180, extra_args=(strategy,))
     assert set(results) == {"0", "1"}
     assert all(r["ok"] == "1" for r in results.values())
 

@@ -474,3 +474,36 @@ class TestBlackboxTailRegressions:
         res = run_trial(trial, store, OBJ)
         assert res.condition is TrialCondition.SUCCEEDED
         assert [l.value for l in store.get("nl1", "accuracy")] == [0.93]
+
+
+class TestProfilerTracing:
+    def test_profiler_trace_written_per_trial(self, tmp_path):
+        """config.init.enable_profiler=True captures a jax.profiler trace
+        under <trial>/profile (the tracing aux subsystem SURVEY §5 notes the
+        reference lacks entirely)."""
+        import glob as _glob
+        import os
+
+        import jax.numpy as jnp
+
+        from katib_tpu.core.config import KatibConfig
+
+        def train(ctx):
+            # some device work so the trace has content
+            v = float(jnp.square(jnp.asarray(float(ctx.params["x"]))))
+            ctx.report(step=0, accuracy=1.0 / (1.0 + v))
+
+        spec = make_spec(name="prof-exp", max_trial_count=2, parallel_trial_count=1)
+        spec.train_fn = train
+        cfg = KatibConfig()
+        cfg.init.enable_profiler = True
+        orch = Orchestrator(workdir=str(tmp_path), config=cfg)
+        exp = orch.run(spec)
+        assert exp.succeeded_count == 2
+        traces = _glob.glob(
+            str(tmp_path / "prof-exp" / "*" / "profile" / "**" / "*"),
+            recursive=True,
+        )
+        # at least one trial produced trace artifacts (the profiler is a
+        # process-global singleton; the lock serializes access)
+        assert any(os.path.isfile(t) for t in traces), traces
