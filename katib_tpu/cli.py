@@ -186,6 +186,24 @@ def cmd_metrics(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_logs(args: argparse.Namespace) -> int:
+    """Print a black-box trial's captured stdout (reference: UI pod-log
+    fetch, ``backend.go:463``); lookup shared with the UI via
+    ``status.read_trial_log``."""
+    from katib_tpu.orchestrator.status import read_trial_log
+
+    log = read_trial_log(args.workdir, args.trial)
+    if log is None:
+        print(
+            f"no captured log for trial {args.trial!r} under {args.workdir} "
+            "(white-box trials have no stdout log)",
+            file=sys.stderr,
+        )
+        return 1
+    sys.stdout.write(log)
+    return 0
+
+
 def cmd_conformance(args: argparse.Namespace) -> int:
     """Packaged conformance run (parity with the reference's
     ``conformance/run.sh``: deploy, run random-search e2e, assert the
@@ -399,6 +417,11 @@ def main(argv: list[str] | None = None) -> int:
     p = sub.add_parser("metrics", help="dump a trial's metric log")
     p.add_argument("trial")
     p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser("logs", help="print a black-box trial's captured stdout")
+    p.add_argument("trial")
+    p.add_argument("--workdir", default="katib_runs")
+    p.set_defaults(fn=cmd_logs)
 
     p = sub.add_parser("conformance", help="packaged e2e invariants check")
     p.add_argument("--max-trials", type=int, default=8)

@@ -112,6 +112,48 @@ def read_status(workdir: str, experiment_name: str) -> dict | None:
         return None
 
 
+def find_trial_log(workdir: str, trial_name: str) -> str | None:
+    """Locate a black-box trial's captured stdout (``trial.log``), shared by
+    the CLI and UI so the lookup cannot drift.
+
+    Resolution order per experiment journal: the trial's recorded
+    ``checkpoint_dir`` (suggester-owned dirs — PBT lineage — live outside
+    the ``<workdir>/<exp>/<trial>`` convention), then the conventional
+    path.  Returns the log's path or None."""
+    from katib_tpu.utils.names import is_safe_path_component
+
+    if not is_safe_path_component(trial_name):
+        return None
+    try:
+        exp_dirs = sorted(os.listdir(workdir))
+    except OSError:
+        return None
+    for exp in exp_dirs:
+        status = read_status(workdir, exp)
+        candidates = []
+        if status is not None:
+            tdata = (status.get("trials") or {}).get(trial_name)
+            if tdata and tdata.get("checkpoint_dir"):
+                candidates.append(os.path.join(tdata["checkpoint_dir"], "trial.log"))
+        candidates.append(os.path.join(workdir, exp, trial_name, "trial.log"))
+        for path in candidates:
+            if os.path.isfile(path):
+                return path
+    return None
+
+
+def read_trial_log(workdir: str, trial_name: str) -> str | None:
+    """Contents of a trial's captured stdout, or None when absent."""
+    path = find_trial_log(workdir, trial_name)
+    if path is None:
+        return None
+    try:
+        with open(path, errors="replace") as f:
+            return f.read()
+    except OSError:
+        return None
+
+
 def list_statuses(workdir: str) -> list[dict]:
     out = []
     try:

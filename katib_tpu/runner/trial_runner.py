@@ -149,19 +149,43 @@ class _LineSource:
 
 
 class _StdoutSource(_LineSource):
-    """Drains the process's stdout on a reader thread (never blocks poll)."""
+    """Drains the process's stdout on a reader thread (never blocks poll).
 
-    def __init__(self, proc: subprocess.Popen):
+    When ``log_path`` is given every line is also persisted there — the
+    analog of the reference wrapping the trainer as ``<cmd>
+    1>/var/log/katib/metrics.log 2>&1`` (``pod/utils.go:199``) so the UI
+    can serve trial logs after the pod is gone."""
+
+    def __init__(self, proc: subprocess.Popen, log_path: str | None = None):
         self._lines: list[str] = []
         self._lock = threading.Lock()
+        self._log = None
+        if log_path:
+            try:
+                # line-buffered: each line reaches disk as it's drained, so
+                # the log is servable while the trial runs and survives a
+                # reader thread that never reaches EOF (orphaned pipe)
+                self._log = open(log_path, "w", buffering=1, errors="replace")
+            except OSError:
+                self._log = None  # log capture is best-effort
         self._thread = threading.Thread(target=self._drain, args=(proc,), daemon=True)
         self._thread.start()
 
     def _drain(self, proc: subprocess.Popen) -> None:
         assert proc.stdout is not None
         for line in proc.stdout:
+            if self._log is not None:
+                try:
+                    self._log.write(line)
+                except OSError:
+                    pass
             with self._lock:
                 self._lines.append(line)
+        if self._log is not None:
+            try:
+                self._log.close()
+            except OSError:
+                pass
 
     def poll(self) -> list[str]:
         with self._lock:
@@ -310,7 +334,14 @@ def _run_blackbox(
 
     # metrics come from exactly one source: the file when configured, else
     # stdout (no double-reporting); stdout is always drained to avoid blocking
-    stdout_source = _StdoutSource(proc)
+    log_path = None
+    if trial.checkpoint_dir:
+        try:
+            os.makedirs(trial.checkpoint_dir, exist_ok=True)
+            log_path = os.path.join(trial.checkpoint_dir, "trial.log")
+        except OSError:
+            log_path = None
+    stdout_source = _StdoutSource(proc, log_path=log_path)
     source: _LineSource = _FileTailSource(collector.path) if use_file else stdout_source
 
     early_stopped = False

@@ -275,6 +275,20 @@ class UiServer:
             return 404, {"error": f"experiment {name!r} not found"}
         return 200, _trial_rows(status)
 
+    def trial_logs(self, trial_name: str):
+        """Captured stdout of a black-box trial (reference UI fetches pod
+        logs, ``backend.go:463``); resolution shared with the CLI via
+        ``status.read_trial_log``."""
+        from katib_tpu.orchestrator.status import read_trial_log
+
+        log = read_trial_log(self.workdir, trial_name)
+        if log is None:
+            return 404, {
+                "error": f"no captured log for trial {trial_name!r} "
+                "(white-box trials report metrics in-process and have no stdout log)"
+            }
+        return 200, {"trial": trial_name, "log": log}
+
     def trial_metrics(self, trial_name: str):
         if self.store is None:
             return 503, {"error": "no observation store attached"}
@@ -324,6 +338,8 @@ class UiServer:
                 return self.nas(name, (query.get("trial") or [None])[0])
         if len(parts) == 4 and parts[1] == "trial" and parts[3] == "metrics":
             return self.trial_metrics(parts[2])
+        if len(parts) == 4 and parts[1] == "trial" and parts[3] == "logs":
+            return self.trial_logs(parts[2])
         return 404, {"error": "not found"}
 
     def route_post(self, path: str, payload: dict):

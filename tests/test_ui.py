@@ -312,6 +312,34 @@ class TestUiWritePath:
         finally:
             ui.stop()
 
+    def test_trial_logs_served_after_run(self, tmp_path):
+        """Captured black-box stdout is servable after the trial exits —
+        parity with the reference UI's pod-log fetch (backend.go:463)."""
+        import time as _time
+
+        ui = start_ui(str(tmp_path), MemoryObservationStore())
+        try:
+            status, reply = _post(
+                ui.port, "/api/experiments", {"yaml": EXP_YAML.format(name="logs-exp")}
+            )
+            assert status == 201
+            deadline = _time.time() + 60
+            while _time.time() < deadline:
+                s, _, body = _get(ui.port, "/api/experiment/logs-exp")
+                if s == 200 and json.loads(body)["condition"] == "MaxTrialsReached":
+                    break
+                _time.sleep(0.2)
+            s, _, body = _get(ui.port, "/api/experiment/logs-exp/trials")
+            trial = json.loads(body)[0]["name"]
+            s, _, body = _get(ui.port, f"/api/trial/{trial}/logs")
+            assert s == 200
+            payload = json.loads(body)
+            assert "score=" in payload["log"]
+            s, _, _b = _get_raw_status(ui.port, "/api/trial/no-such-trial/logs")
+            assert s == 404
+        finally:
+            ui.stop()
+
     def test_tokenless_writes_reject_foreign_host(self, tmp_path):
         """DNS-rebinding guard: with no token configured, a write whose Host
         header names a foreign domain (a rebound attacker origin) is 403."""
