@@ -389,3 +389,34 @@ def _get_raw_status(port, path):
         return _get(port, path)
     except urllib.error.HTTPError as e:
         return e.code, None, e.read()
+
+
+class TestTrialLogResolution:
+    def test_checkpoint_dir_preferred_over_convention(self, tmp_path):
+        """find_trial_log resolves via the journal's recorded checkpoint_dir
+        first (PBT lineage dirs live outside <workdir>/<exp>/<trial>)."""
+        import os
+
+        from katib_tpu.orchestrator.status import find_trial_log, read_trial_log
+
+        outside = tmp_path / "lineage" / "t-1"
+        os.makedirs(outside)
+        (outside / "trial.log").write_text("from-lineage\n")
+        exp_dir = tmp_path / "runs" / "exp-a"
+        os.makedirs(exp_dir)
+        (exp_dir / "status.json").write_text(json.dumps({
+            "name": "exp-a", "condition": "Succeeded",
+            "trials": {"t-1": {"name": "t-1", "condition": "Succeeded",
+                               "assignments": {},
+                               "checkpoint_dir": str(outside)}},
+        }))
+        workdir = str(tmp_path / "runs")
+        assert find_trial_log(workdir, "t-1") == str(outside / "trial.log")
+        assert read_trial_log(workdir, "t-1") == "from-lineage\n"
+        # conventional fallback still works when the journal lacks the dir
+        conv = exp_dir / "t-2"
+        os.makedirs(conv)
+        (conv / "trial.log").write_text("conventional\n")
+        assert read_trial_log(workdir, "t-2") == "conventional\n"
+        # unsafe names refuse
+        assert find_trial_log(workdir, "../t-1") is None
