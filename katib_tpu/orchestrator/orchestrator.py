@@ -340,11 +340,33 @@ class Orchestrator:
         want = _math.prod(axes.values())
         return make_mesh(axes, devices=jax.devices()[:want])
 
+    #: trial label naming how many devices its lease should span (elastic
+    #: allocator only) — suggesters/users raise it per rung the way
+    #: Hyperband raises epochs
+    DEVICES_LABEL = "katib-tpu/devices"
+
     def _execute(self, exp: Experiment, trial: Trial, mesh):
         # invariant: never raises — _harvest calls f.result() bare
         if self.slice_allocator is not None and mesh is None:
             try:
-                with self.slice_allocator.slice_mesh() as trial_mesh:
+                kwargs = {}
+                want = trial.spec.labels.get(self.DEVICES_LABEL)
+                if want is not None:
+                    from katib_tpu.parallel.distributed import ElasticSliceAllocator
+
+                    if isinstance(self.slice_allocator, ElasticSliceAllocator):
+                        # clamp both directions: a suggester that keeps
+                        # doubling the budget past the machine gets the whole
+                        # machine (top-rung survivors must not FAIL), and
+                        # garbage parses as the 1-device minimum
+                        try:
+                            n = int(float(want))
+                        except (TypeError, ValueError):
+                            n = 1
+                        kwargs["n_devices"] = min(
+                            max(1, n), self.slice_allocator.n_devices
+                        )
+                with self.slice_allocator.slice_mesh(**kwargs) as trial_mesh:
                     return self._execute_with_retry(exp, trial, trial_mesh)
             except Exception:
                 return TrialResult(TrialCondition.FAILED, traceback.format_exc(limit=20))

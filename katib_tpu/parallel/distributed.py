@@ -112,7 +112,29 @@ class SliceLease:
         return make_mesh(dict(self.axes), devices=self.devices)
 
 
-class SliceAllocator:
+def _default_devices(devices: Sequence[Any] | None) -> tuple:
+    if devices is None:
+        import jax
+
+        devices = jax.devices()
+    return tuple(devices)
+
+
+class _MeshLeaseMixin:
+    """Shared lease→mesh→release context manager for the allocators."""
+
+    @contextmanager
+    def slice_mesh(self, *args, **kwargs):
+        """``with allocator.slice_mesh(...) as mesh:`` — lease, build,
+        release; arguments pass through to ``lease``."""
+        lease = self.lease(*args, **kwargs)
+        try:
+            yield lease.mesh()
+        finally:
+            self.release(lease)
+
+
+class SliceAllocator(_MeshLeaseMixin):
     """Partition devices into equal slice shares; lease one per trial.
 
     ``axes`` is the per-trial mesh template (one axis may be -1 to absorb
@@ -129,10 +151,7 @@ class SliceAllocator:
         devices: Sequence[Any] | None = None,
         axes: Mapping[str, int] | None = None,
     ):
-        if devices is None:
-            import jax
-
-            devices = jax.devices()
+        devices = _default_devices(devices)
         if slice_size <= 0:
             raise ValueError("slice_size must be positive")
         if len(devices) < slice_size:
@@ -172,11 +191,97 @@ class SliceAllocator:
             self._free.append(lease)
             self._cond.notify()
 
-    @contextmanager
-    def slice_mesh(self, timeout: float | None = None):
-        """``with allocator.slice_mesh() as mesh:`` — lease, build, release."""
-        lease = self.lease(timeout)
-        try:
-            yield lease.mesh()
-        finally:
-            self.release(lease)
+
+
+class ElasticSliceAllocator(_MeshLeaseMixin):
+    """Variable-size device leasing: each trial asks for the number of chips
+    it wants (``lease(n)``), the allocator grants n contiguous devices.
+
+    This is the elasticity the reference cannot express (SURVEY §7 hard part
+    (b)): Hyperband/PBT rungs can raise a trial's *device* budget between
+    rungs the same way they raise epochs — promoted survivors get bigger
+    sub-meshes, early rungs run many small ones.  Contiguity keeps a lease's
+    collectives on neighboring chips (ICI locality on a real slice; on the
+    virtual CPU mesh it is simply deterministic packing).
+
+    Grants are FIFO-fair: a large request blocks later smaller ones instead
+    of starving behind them (head-of-line semantics — the simple policy that
+    guarantees progress for every size).
+    """
+
+    def __init__(self, devices: Sequence[Any] | None = None, *, axes=None):
+        self._devices = _default_devices(devices)
+        self.axes = dict(axes) if axes else {DATA_AXIS: -1}
+        self._free = [True] * len(self._devices)
+        self._cond = threading.Condition()
+        self._queue: list[object] = []  # FIFO tickets
+
+    @property
+    def n_devices(self) -> int:
+        return len(self._devices)
+
+    def available(self) -> int:
+        with self._cond:
+            return sum(self._free)
+
+    def pending(self) -> int:
+        """Requests currently queued (waiting for a grant)."""
+        with self._cond:
+            return len(self._queue)
+
+    def _find_run(self, n: int) -> int | None:
+        """Lowest start index of n contiguous free devices, else None."""
+        run = 0
+        for i, free in enumerate(self._free):
+            run = run + 1 if free else 0
+            if run == n:
+                return i - n + 1
+        return None
+
+    def lease(self, n_devices: int = 1, timeout: float | None = None) -> SliceLease:
+        if not 1 <= n_devices <= len(self._devices):
+            raise ValueError(
+                f"n_devices must be in [1, {len(self._devices)}], got {n_devices}"
+            )
+        ticket = object()
+        with self._cond:
+            self._queue.append(ticket)
+            try:
+                def ready():
+                    return (
+                        self._queue[0] is ticket
+                        and self._find_run(n_devices) is not None
+                    )
+
+                if not self._cond.wait_for(ready, timeout=timeout):
+                    raise TimeoutError(
+                        f"no {n_devices}-device run within {timeout}s "
+                        f"({self.available()}/{len(self._devices)} free)"
+                    )
+                start = self._find_run(n_devices)
+                assert start is not None
+                for i in range(start, start + n_devices):
+                    self._free[i] = False
+                self._queue.pop(0)
+                # the next waiter may also be satisfiable (e.g. it wants
+                # fewer devices than remain free)
+                self._cond.notify_all()
+                return SliceLease(
+                    index=start,
+                    devices=self._devices[start : start + n_devices],
+                    axes=self.axes,
+                )
+            except BaseException:
+                if ticket in self._queue:
+                    self._queue.remove(ticket)
+                    self._cond.notify_all()
+                raise
+
+    def release(self, lease: SliceLease) -> None:
+        with self._cond:
+            for i in range(lease.index, lease.index + len(lease.devices)):
+                if self._free[i]:
+                    raise ValueError(f"device {i} is not leased")
+                self._free[i] = True
+            self._cond.notify_all()
+

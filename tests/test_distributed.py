@@ -128,3 +128,148 @@ class TestOrchestratorSliceScheduling:
         assert all(len(d) == 2 for d in seen)
         # every lease was returned
         assert alloc.available() == alloc.n_slices
+
+
+class TestElasticSliceAllocator:
+    def _alloc(self, n=8):
+        import jax
+
+        from katib_tpu.parallel.distributed import ElasticSliceAllocator
+
+        return ElasticSliceAllocator(devices=jax.devices()[:n])
+
+    def test_variable_sizes_and_contiguity(self):
+        a = self._alloc()
+        l4 = a.lease(4)
+        l2 = a.lease(2)
+        l1 = a.lease(1)
+        assert [d.id for d in l4.devices] == [0, 1, 2, 3]
+        assert [d.id for d in l2.devices] == [4, 5]
+        assert l1.devices[0].id == 6
+        assert a.available() == 1
+        a.release(l2)
+        # freed run is reused
+        l2b = a.lease(2)
+        assert [d.id for d in l2b.devices] == [4, 5]
+        for lease in (l4, l1, l2b):
+            a.release(lease)
+        assert a.available() == 8
+
+    def test_mesh_from_lease(self):
+        a = self._alloc()
+        with a.slice_mesh(n_devices=4) as mesh:
+            assert mesh.devices.size == 4
+        assert a.available() == 8
+
+    def test_blocking_and_fifo_fairness(self):
+        """A big request queued first is granted before later small ones
+        (no starvation), and release order doesn't matter."""
+        import threading
+        import time as _time
+
+        a = self._alloc()
+        l6 = a.lease(6)
+        order: list[str] = []
+
+        def want(n, tag):
+            lease = a.lease(n)
+            order.append(tag)
+            _time.sleep(0.05)
+            a.release(lease)
+
+        big = threading.Thread(target=want, args=(4, "big"))
+        big.start()
+        deadline = _time.monotonic() + 10
+        while a.pending() < 1 and _time.monotonic() < deadline:
+            _time.sleep(0.005)  # big is queued first, needs 4, only 2 free
+        assert a.pending() == 1
+        small = threading.Thread(target=want, args=(1, "small"))
+        small.start()
+        while a.pending() < 2 and _time.monotonic() < deadline:
+            _time.sleep(0.005)
+        # head-of-line: small must NOT have jumped the queue
+        assert order == []
+        a.release(l6)
+        big.join(timeout=10)
+        small.join(timeout=10)
+        assert order == ["big", "small"]
+
+    def test_invalid_sizes_rejected(self):
+        a = self._alloc()
+        with pytest.raises(ValueError):
+            a.lease(0)
+        with pytest.raises(ValueError):
+            a.lease(9)
+        with pytest.raises(TimeoutError):
+            l8 = a.lease(8)
+            try:
+                a.lease(1, timeout=0.1)
+            finally:
+                a.release(l8)
+
+    def test_orchestrator_honors_device_label(self, tmp_path):
+        """Trials with the katib-tpu/devices label get leases of that size —
+        rung-scalable device budgets (SURVEY §7 hard part b)."""
+        import jax
+
+        from katib_tpu.core.types import (
+            AlgorithmSpec,
+            ExperimentSpec,
+            FeasibleSpace,
+            ObjectiveSpec,
+            ObjectiveType,
+            ParameterSpec,
+            ParameterType,
+        )
+        from katib_tpu.orchestrator import Orchestrator
+        from katib_tpu.parallel.distributed import ElasticSliceAllocator
+        from katib_tpu.suggest.base import Suggester, _REGISTRY, register
+
+        seen: dict[str, int] = {}
+
+        @register("sizing-stub")
+        class SizingStub(Suggester):
+            def get_suggestions(self, experiment, count):
+                from katib_tpu.core.types import ParameterAssignment, TrialAssignmentSet
+
+                out = []
+                done = len(experiment.trials)
+                for i in range(count):
+                    n = 4 if (done + i) % 2 else 2
+                    out.append(
+                        TrialAssignmentSet(
+                            assignments=[ParameterAssignment("x", 0.1)],
+                            labels={"katib-tpu/devices": str(n)},
+                        )
+                    )
+                return out
+
+        def train(ctx):
+            seen[ctx.trial_name] = ctx.mesh.devices.size
+            ctx.report(step=0, accuracy=0.5)
+
+        try:
+            spec = ExperimentSpec(
+                name="elastic-exp",
+                objective=ObjectiveSpec(
+                    type=ObjectiveType.MAXIMIZE, objective_metric_name="accuracy"
+                ),
+                algorithm=AlgorithmSpec(name="sizing-stub"),
+                parameters=[
+                    ParameterSpec(
+                        "x", ParameterType.DOUBLE, FeasibleSpace(min=0.0, max=1.0)
+                    )
+                ],
+                max_trial_count=6,
+                parallel_trial_count=3,
+                train_fn=train,
+            )
+            alloc = ElasticSliceAllocator(devices=jax.devices())
+            exp = Orchestrator(
+                workdir=str(tmp_path), slice_allocator=alloc
+            ).run(spec)
+            assert exp.succeeded_count == 6
+            assert sorted(seen.values()) == [2, 2, 2, 4, 4, 4]
+            assert alloc.available() == alloc.n_devices
+        finally:
+            _REGISTRY.pop("sizing-stub", None)
