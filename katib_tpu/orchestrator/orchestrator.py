@@ -354,6 +354,18 @@ class Orchestrator:
                 if want is not None:
                     from katib_tpu.parallel.distributed import ElasticSliceAllocator
 
+                    if not isinstance(self.slice_allocator, ElasticSliceAllocator):
+                        if not getattr(self, "_warned_devices_label", False):
+                            self._warned_devices_label = True
+                            import warnings
+
+                            warnings.warn(
+                                f"trials carry the {self.DEVICES_LABEL} label but "
+                                "the orchestrator's allocator is fixed-size; use "
+                                "ElasticSliceAllocator for rung-scalable leases",
+                                RuntimeWarning,
+                                stacklevel=2,
+                            )
                     if isinstance(self.slice_allocator, ElasticSliceAllocator):
                         # clamp both directions: a suggester that keeps
                         # doubling the budget past the machine gets the whole

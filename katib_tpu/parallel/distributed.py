@@ -279,9 +279,13 @@ class ElasticSliceAllocator(_MeshLeaseMixin):
 
     def release(self, lease: SliceLease) -> None:
         with self._cond:
-            for i in range(lease.index, lease.index + len(lease.devices)):
+            span = range(lease.index, lease.index + len(lease.devices))
+            # validate the WHOLE range before mutating: a double release must
+            # not free devices that now belong to another live lease
+            for i in span:
                 if self._free[i]:
                     raise ValueError(f"device {i} is not leased")
+            for i in span:
                 self._free[i] = True
             self._cond.notify_all()
 

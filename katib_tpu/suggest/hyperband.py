@@ -208,6 +208,20 @@ class HyperbandSuggester(Suggester):
                 state = {"s": s - 1, "i": 0}
             self._save_state(experiment, state)
 
+    def _rung_labels(self, s: int, i: int, r: int) -> dict[str, str]:
+        """Rung identity labels, plus the per-trial device budget when
+        ``devices_per_rung`` is set: the rung's resource value ALSO sizes the
+        trial's sub-mesh lease (``katib-tpu/devices``, honored by the
+        orchestrator's ElasticSliceAllocator) — survivors get more chips,
+        not just more epochs.  TPU-native elasticity the reference has no
+        analog for (its ``r_i`` can only reach the container's argv)."""
+        labels = {S_LABEL: str(s), I_LABEL: str(i)}
+        if str(self.spec.algorithm.setting("devices_per_rung") or "").lower() in (
+            "1", "true", "yes",
+        ):
+            labels["katib-tpu/devices"] = str(r)
+        return labels
+
     def _master_rung(
         self,
         space: SpaceEncoder,
@@ -229,7 +243,7 @@ class HyperbandSuggester(Suggester):
             out.append(
                 TrialAssignmentSet(
                     assignments=space.to_assignments(params),
-                    labels={S_LABEL: str(s), I_LABEL: "0"},
+                    labels=self._rung_labels(s, 0, r),
                 )
             )
         return out
@@ -244,10 +258,9 @@ class HyperbandSuggester(Suggester):
             )
             for a in trial.spec.assignments
         ]
-        return TrialAssignmentSet(
-            assignments=assignments,
-            labels={S_LABEL: str(s), I_LABEL: str(i), "hyperband-parent": trial.name},
-        )
+        labels = self._rung_labels(s, i, r)
+        labels["hyperband-parent"] = trial.name
+        return TrialAssignmentSet(assignments=assignments, labels=labels)
 
     def total_budget(self) -> int:
         """Total number of trials hyperband will run (for budget planning)."""

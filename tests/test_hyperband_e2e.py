@@ -132,3 +132,57 @@ def test_hyperband_32_trial_sweep_with_slice_leasing(tmp_path):
     assert 1 < concurrency["peak"] <= 8
     assert len(seen_devices) == 32
     assert all(len(d) == 1 for d in seen_devices)
+
+
+def test_devices_per_rung_scales_leases(tmp_path):
+    """hyperband setting devices_per_rung=true: the rung resource value also
+    sizes each trial's sub-mesh lease — promoted survivors run on more
+    chips (ElasticSliceAllocator elasticity, SURVEY §7 hard part b)."""
+    from katib_tpu.parallel.distributed import ElasticSliceAllocator
+
+    seen: dict[str, int] = {}
+    lock = threading.Lock()
+
+    def train(ctx):
+        with lock:
+            seen[ctx.trial_name] = ctx.mesh.devices.size
+        acc = 1.0 - (float(ctx.params["lr"]) - 0.1) ** 2
+        for epoch in range(int(float(ctx.params["epochs"]))):
+            if not ctx.report(step=epoch, accuracy=acc * (epoch + 1)):
+                return
+
+    spec = ExperimentSpec(
+        name="hb-devices",
+        algorithm=AlgorithmSpec(
+            name="hyperband",
+            settings={
+                "r_l": "4", "resource_name": "epochs", "eta": "2",
+                "devices_per_rung": "true",
+            },
+        ),
+        objective=ObjectiveSpec(
+            type=ObjectiveType.MAXIMIZE, objective_metric_name="accuracy"
+        ),
+        parameters=[
+            ParameterSpec("lr", ParameterType.DOUBLE, FeasibleSpace(min=0.01, max=0.5)),
+            ParameterSpec("epochs", ParameterType.INT, FeasibleSpace(min=1, max=4)),
+        ],
+        max_trial_count=None,
+        parallel_trial_count=4,
+        train_fn=train,
+    )
+    alloc = ElasticSliceAllocator(devices=jax.devices())
+    exp = Orchestrator(workdir=str(tmp_path), slice_allocator=alloc).run(spec)
+    assert exp.succeeded_count >= 4
+    # every trial's mesh matched its rung resource (epochs == devices here)
+    for t in exp.trials.values():
+        want = int(float(t.params()["epochs"]))
+        assert seen[t.name] == min(want, alloc.n_devices), (t.name, want)
+    # at least one promoted trial ran on a bigger mesh than its parent
+    grew = [
+        t for t in exp.trials.values()
+        if t.labels.get("hyperband-parent")
+        and seen[t.name] > seen[t.labels["hyperband-parent"]]
+    ]
+    assert grew, "no promotion increased the device budget"
+    assert alloc.available() == alloc.n_devices
