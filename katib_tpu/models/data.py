@@ -106,6 +106,32 @@ def _load_or_synthesize(
     return synthetic_classification(n_train, n_test, shape, num_classes, seed=seed)
 
 
+def load_digits_real(n_train: int = 1400, n_test: int = 397) -> Dataset:
+    """REAL handwritten-digit data, no egress needed: scikit-learn's bundled
+    UCI digits (1797 samples of 8x8 grayscale).  The one dataset in this
+    image that is not synthetic — accuracy numbers on it are real-world
+    evidence, unlike the synthetic fallbacks above (big-dataset parity still
+    goes through the ``KATIB_DATA_DIR`` npz path).  Needs scikit-learn (the
+    ``bayesopt`` extra); raises ImportError on a base install."""
+    from sklearn.datasets import load_digits as _sk_load
+
+    d = _sk_load()
+    n_total = len(d.images)
+    n_train = min(n_train, n_total - 1)
+    n_test = min(n_test, n_total - n_train)
+    rng = np.random.default_rng(0)
+    perm = rng.permutation(n_total)
+    x = (d.images[perm].astype(np.float32) / 16.0)[..., None]  # [N, 8, 8, 1]
+    y = d.target[perm].astype(np.int32)
+    return Dataset(
+        x_train=x[:n_train],
+        y_train=y[:n_train],
+        x_test=x[n_train : n_train + n_test],
+        y_test=y[n_train : n_train + n_test],
+        num_classes=10,
+    )
+
+
 def using_real_data(name: str) -> bool:
     """True when a cached real ``.npz`` backs ``name`` (vs the synthetic
     fallback) — run logs record this so synthetic separability is never

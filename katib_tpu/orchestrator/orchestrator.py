@@ -342,8 +342,9 @@ class Orchestrator:
 
     #: trial label naming how many devices its lease should span (elastic
     #: allocator only) — suggesters/users raise it per rung the way
-    #: Hyperband raises epochs
-    DEVICES_LABEL = "katib-tpu/devices"
+    #: Hyperband raises epochs; the string lives in parallel.distributed so
+    #: producers and this consumer share one definition
+    from katib_tpu.parallel.distributed import DEVICES_LABEL
 
     def _execute(self, exp: Experiment, trial: Trial, mesh):
         # invariant: never raises — _harvest calls f.result() bare

@@ -99,6 +99,12 @@ def topology_size(topology: str) -> int:
 
 # -- per-trial slice leasing -------------------------------------------------
 
+#: trial label naming the device count a trial's lease should span —
+#: producers (suggesters, users) and the consumer (orchestrator) share this
+#: one constant so the elasticity contract cannot silently split
+DEVICES_LABEL = "katib-tpu/devices"
+
+
 
 @dataclass
 class SliceLease:
@@ -215,6 +221,9 @@ class ElasticSliceAllocator(_MeshLeaseMixin):
         self._free = [True] * len(self._devices)
         self._cond = threading.Condition()
         self._queue: list[object] = []  # FIFO tickets
+        # start-index -> the live lease object: release() checks identity so
+        # a stale double release can never free a successor lease's devices
+        self._live: dict[int, SliceLease] = {}
 
     @property
     def n_devices(self) -> int:
@@ -266,11 +275,13 @@ class ElasticSliceAllocator(_MeshLeaseMixin):
                 # the next waiter may also be satisfiable (e.g. it wants
                 # fewer devices than remain free)
                 self._cond.notify_all()
-                return SliceLease(
+                lease = SliceLease(
                     index=start,
                     devices=self._devices[start : start + n_devices],
                     axes=self.axes,
                 )
+                self._live[start] = lease
+                return lease
             except BaseException:
                 if ticket in self._queue:
                     self._queue.remove(ticket)
@@ -279,13 +290,15 @@ class ElasticSliceAllocator(_MeshLeaseMixin):
 
     def release(self, lease: SliceLease) -> None:
         with self._cond:
-            span = range(lease.index, lease.index + len(lease.devices))
-            # validate the WHOLE range before mutating: a double release must
-            # not free devices that now belong to another live lease
-            for i in span:
-                if self._free[i]:
-                    raise ValueError(f"device {i} is not leased")
-            for i in span:
+            # identity check first: a stale lease (double release, or a span
+            # since re-leased to someone else) must never free devices
+            if self._live.get(lease.index) is not lease:
+                raise ValueError(
+                    f"lease at device {lease.index} is not live (double "
+                    "release, or its span was re-leased)"
+                )
+            del self._live[lease.index]
+            for i in range(lease.index, lease.index + len(lease.devices)):
                 self._free[i] = True
             self._cond.notify_all()
 

@@ -219,7 +219,9 @@ class HyperbandSuggester(Suggester):
         if str(self.spec.algorithm.setting("devices_per_rung") or "").lower() in (
             "1", "true", "yes",
         ):
-            labels["katib-tpu/devices"] = str(r)
+            from katib_tpu.parallel.distributed import DEVICES_LABEL
+
+            labels[DEVICES_LABEL] = str(r)
         return labels
 
     def _master_rung(
