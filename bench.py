@@ -200,7 +200,9 @@ def _child() -> None:
     )
 
 
-def _run_attempt(deadline: float) -> tuple[int, dict | None, str]:
+def _run_attempt(
+    deadline: float, env: dict | None = None
+) -> tuple[int, dict | None, str]:
     """One measurement attempt in a child process.  Returns
     (returncode, parsed result or None, stderr tail)."""
     proc = subprocess.Popen(
@@ -208,6 +210,7 @@ def _run_attempt(deadline: float) -> tuple[int, dict | None, str]:
         stdout=subprocess.PIPE,
         stderr=subprocess.PIPE,
         text=True,
+        env=env,
     )
     try:
         out, err = proc.communicate(timeout=deadline)
@@ -256,9 +259,32 @@ def main() -> None:
     print(
         f"bench: all {retries} attempts failed (last rc={last_rc}); "
         "the TPU pool looks wedged (stale grant on the axon relay) — "
-        "a later run usually recovers once the grant expires",
+        "falling back to an honestly-labeled CPU measurement "
+        "(platform/tpu_unavailable fields mark it; set BENCH_NO_FALLBACK=1 "
+        "to get exit 3 instead)",
         file=sys.stderr,
     )
+    if os.environ.get("BENCH_NO_FALLBACK", "") not in ("", "0"):
+        sys.exit(3)
+    # honest fallback: a real measurement of the same step at reduced shapes
+    # on CPU, explicitly labeled — a recorded number the reader can see is
+    # not a TPU number beats an empty round
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_SMALL"] = "1"  # full shapes would take hours on CPU
+    rc, result, err = _run_attempt(1800.0, env=env)
+    if result is not None:
+        result["tpu_unavailable"] = True
+        result["tpu_failure"] = f"rc={last_rc}"
+        # the small-shape img/s is not comparable to the full-shape
+        # baseline ratio; record the config instead of a bogus ratio
+        result["vs_baseline"] = None
+        result["config"] = {
+            "batch": 8, "num_layers": 2, "init_channels": 4, "small_shapes": True,
+        }
+        print(json.dumps(result))
+        return
+    print(f"bench: CPU fallback also failed rc={rc}:\n{err}", file=sys.stderr)
     sys.exit(3)
 
 

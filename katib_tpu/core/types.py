@@ -648,6 +648,13 @@ class OptimalTrial:
     observation: Observation
 
 
+# Trial label naming the device count the trial's sub-mesh lease should
+# span.  Lives here (jax-free module) so the producers (suggesters) and the
+# consumer (orchestrator + ElasticSliceAllocator) share one definition
+# without dragging jax into metadata-only import paths.
+DEVICES_LABEL = "katib-tpu/devices"
+
+
 @dataclass
 class Experiment:
     """Experiment instance + live status (spec + the reference's ExperimentStatus,

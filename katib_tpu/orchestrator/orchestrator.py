@@ -32,6 +32,7 @@ import time
 import traceback
 
 from katib_tpu.core.types import (
+    DEVICES_LABEL as _DEVICES_LABEL,
     Experiment,
     ExperimentCondition,
     ExperimentSpec,
@@ -342,9 +343,8 @@ class Orchestrator:
 
     #: trial label naming how many devices its lease should span (elastic
     #: allocator only) — suggesters/users raise it per rung the way
-    #: Hyperband raises epochs; the string lives in parallel.distributed so
-    #: producers and this consumer share one definition
-    from katib_tpu.parallel.distributed import DEVICES_LABEL
+    #: Hyperband raises epochs; one shared jax-free definition in core.types
+    DEVICES_LABEL = _DEVICES_LABEL
 
     def _execute(self, exp: Experiment, trial: Trial, mesh):
         # invariant: never raises — _harvest calls f.result() bare

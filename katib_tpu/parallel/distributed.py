@@ -99,10 +99,9 @@ def topology_size(topology: str) -> int:
 
 # -- per-trial slice leasing -------------------------------------------------
 
-#: trial label naming the device count a trial's lease should span —
-#: producers (suggesters, users) and the consumer (orchestrator) share this
-#: one constant so the elasticity contract cannot silently split
-DEVICES_LABEL = "katib-tpu/devices"
+# one shared definition of the device-count trial label (re-exported here
+# for locality with its consumer, defined jax-free in core.types)
+from katib_tpu.core.types import DEVICES_LABEL  # noqa: F401
 
 
 

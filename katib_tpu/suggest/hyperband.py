@@ -219,7 +219,7 @@ class HyperbandSuggester(Suggester):
         if str(self.spec.algorithm.setting("devices_per_rung") or "").lower() in (
             "1", "true", "yes",
         ):
-            from katib_tpu.parallel.distributed import DEVICES_LABEL
+            from katib_tpu.core.types import DEVICES_LABEL
 
             labels[DEVICES_LABEL] = str(r)
         return labels
