@@ -44,8 +44,13 @@ def main() -> int:
     from katib_tpu.models.data import load_mnist, using_real_data
     from katib_tpu.models.mnist import MLP, train_classifier
     from katib_tpu.orchestrator import Orchestrator
-    from katib_tpu.parallel.distributed import SliceAllocator
+    from katib_tpu.parallel.distributed import ElasticSliceAllocator, SliceAllocator
     from katib_tpu.suggest.hyperband import I_LABEL, S_LABEL
+
+    # SWEEP_ELASTIC=1: rung resource also sizes each trial's sub-mesh
+    # (devices_per_rung + ElasticSliceAllocator) — finalists train on
+    # 8-device meshes while rung-0 screens 16 one-device trials
+    elastic = os.environ.get("SWEEP_ELASTIC", "") not in ("", "0")
 
     dataset = load_mnist(
         int(os.environ.get("SWEEP_NTRAIN", "1024")),
@@ -80,12 +85,12 @@ def main() -> int:
             }
         )
 
+    hb_settings = {"r_l": "16", "resource_name": "epochs", "eta": "4"}
+    if elastic:
+        hb_settings["devices_per_rung"] = "true"
     spec = ExperimentSpec(
-        name="hyperband-demo",
-        algorithm=AlgorithmSpec(
-            name="hyperband",
-            settings={"r_l": "16", "resource_name": "epochs", "eta": "4"},
-        ),
+        name="hyperband-elastic" if elastic else "hyperband-demo",
+        algorithm=AlgorithmSpec(name="hyperband", settings=hb_settings),
         objective=ObjectiveSpec(
             type=ObjectiveType.MAXIMIZE, objective_metric_name="accuracy"
         ),
@@ -97,15 +102,26 @@ def main() -> int:
         parallel_trial_count=16,
         train_fn=train,
     )
-    allocator = SliceAllocator(slice_size=1, devices=jax.devices())
+    if elastic:
+        allocator = ElasticSliceAllocator(devices=jax.devices())
+    else:
+        allocator = SliceAllocator(slice_size=1, devices=jax.devices())
     workdir = os.path.join(REPO, "katib_runs")
     exp = Orchestrator(workdir=workdir, slice_allocator=allocator).run(spec)
     wall = time.time() - started
 
+    from katib_tpu.core.types import DEVICES_LABEL
+
     rungs: dict[str, int] = {}
+    devices_by_rung: dict[str, int] = {}
     for t in exp.trials.values():
         key = f"s={t.labels.get(S_LABEL)} rung={t.labels.get(I_LABEL)}"
         rungs[key] = rungs.get(key, 0) + 1
+        if elastic:
+            # mirror the orchestrator's clamp exactly (floor 1, cap machine)
+            want = int(float(t.labels.get(DEVICES_LABEL, "1")))
+            granted = min(max(1, want), len(jax.devices()))
+            devices_by_rung[key] = max(devices_by_rung.get(key, 0), granted)
 
     best_curve = []
     best = float("-inf")
@@ -117,6 +133,7 @@ def main() -> int:
     summary = {
         "experiment": exp.spec.name,
         "condition": exp.condition.value,
+        "elastic_devices": elastic,
         "real_data": using_real_data("mnist"),
         "platform": jax.devices()[0].platform,
         "n_devices": len(jax.devices()),
@@ -131,7 +148,13 @@ def main() -> int:
         "rungs": dict(sorted(rungs.items())),
         "best_objective_vs_wallclock": best_curve,
     }
-    write_artifact("hyperband", "sweep_summary.json", summary)
+    if elastic:
+        summary["devices_by_rung"] = dict(sorted(devices_by_rung.items()))
+    write_artifact(
+        "hyperband",
+        "elastic_summary.json" if elastic else "sweep_summary.json",
+        summary,
+    )
     print(json.dumps({k: summary[k] for k in (
         "condition", "trials_total", "wallclock_s", "trials_per_hour",
         "best_objective",
