@@ -1,0 +1,182 @@
+"""Property-based tests (hypothesis): invariants that example-based tests
+can't sweep.
+
+The highest-value target is native/python parity — the C++ TEXT parser is
+on the metrics hot path and must agree with the Python reference on
+arbitrary input, not just the curated lines in test_native.py.  The rest
+pin encoder round-trips and Hyperband's bracket arithmetic."""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from katib_tpu.core.types import FeasibleSpace, ParameterSpec, ParameterType
+from katib_tpu.suggest.space import SpaceEncoder
+
+# -- strategies --------------------------------------------------------------
+
+_names = st.sampled_from(["lr", "momentum", "units", "opt", "wd"])
+
+
+@st.composite
+def param_specs(draw):
+    kind = draw(st.sampled_from(["double", "int", "categorical"]))
+    name = draw(_names)
+    if kind == "double":
+        lo = draw(st.floats(-100, 100, allow_nan=False))
+        hi = lo + draw(st.floats(0.1, 100, allow_nan=False))
+        return ParameterSpec(name, ParameterType.DOUBLE, FeasibleSpace(min=lo, max=hi))
+    if kind == "int":
+        lo = draw(st.integers(-50, 50))
+        hi = lo + draw(st.integers(1, 100))
+        return ParameterSpec(name, ParameterType.INT, FeasibleSpace(min=lo, max=hi))
+    choices = tuple(
+        draw(
+            st.lists(
+                st.text(
+                    alphabet=st.characters(min_codepoint=33, max_codepoint=126),
+                    min_size=1,
+                    max_size=8,
+                ),
+                min_size=2,
+                max_size=5,
+                unique=True,
+            )
+        )
+    )
+    return ParameterSpec(
+        name, ParameterType.CATEGORICAL, FeasibleSpace(list=choices)
+    )
+
+
+@st.composite
+def spaces(draw):
+    specs = draw(st.lists(param_specs(), min_size=1, max_size=4))
+    # unique names (the encoder keys dimensions by name)
+    seen, uniq = set(), []
+    for i, p in enumerate(specs):
+        if p.name in seen:
+            continue
+        seen.add(p.name)
+        uniq.append(p)
+    return SpaceEncoder(uniq)
+
+
+# -- SpaceEncoder ------------------------------------------------------------
+
+
+class TestSpaceEncoderProperties:
+    @settings(max_examples=200, deadline=None)
+    @given(spaces(), st.integers(0, 2**31 - 1))
+    def test_sample_encode_decode_round_trip(self, space, seed):
+        """decode(encode(x)) == x for any sampled point: values stay inside
+        their feasible spaces and survive the unit-cube round trip."""
+        rng = np.random.default_rng(seed)
+        params = space.sample(rng)
+        u = space.encode(params)
+        assert ((0.0 <= u) & (u <= 1.0)).all()
+        back = space.decode(u)
+        for spec in space.params:
+            v, w = params[spec.name], back[spec.name]
+            if spec.type is ParameterType.CATEGORICAL:
+                assert v == w
+            elif spec.type is ParameterType.INT:
+                assert int(v) == int(w)
+                assert spec.feasible.min <= int(w) <= spec.feasible.max
+            else:
+                assert math.isclose(float(v), float(w), rel_tol=1e-6, abs_tol=1e-6)
+                assert spec.feasible.min - 1e-9 <= float(w) <= spec.feasible.max + 1e-9
+
+    @settings(max_examples=100, deadline=None)
+    @given(spaces(), st.integers(0, 2**31 - 1))
+    def test_onehot_width_and_normalization(self, space, seed):
+        rng = np.random.default_rng(seed)
+        params = space.sample(rng)
+        oh = space.encode_onehot(params)
+        want = sum(
+            len(p.feasible.list) if p.type is ParameterType.CATEGORICAL else 1
+            for p in space.params
+        )
+        assert oh.shape == (want,)
+        assert np.isfinite(oh).all()
+
+
+# -- native TEXT parser parity ----------------------------------------------
+
+
+_line_fragments = st.text(
+    alphabet=st.characters(
+        codec="ascii", exclude_characters="\x00"
+    ),
+    max_size=60,
+)
+
+
+class TestNativeParserFuzz:
+    @settings(max_examples=300, deadline=None)
+    @given(st.lists(_line_fragments, max_size=8))
+    def test_native_matches_python_on_arbitrary_ascii(self, lines):
+        """The C++ parser and the Python reference must extract identical
+        (metric, value, timestamp) sequences from ANY ascii input."""
+        from katib_tpu.native import native_available
+
+        if not native_available():
+            pytest.skip("C++ toolchain unavailable")
+        from katib_tpu.native import parse_text_lines_native
+        from katib_tpu.runner.metrics import parse_text_lines
+
+        # newlines inside a "line" would change framing between the two
+        # call conventions; the runner always splits lines first
+        lines = [l.replace("\n", " ").replace("\r", " ") for l in lines]
+        names = ["loss", "accuracy", "x"]
+        py = parse_text_lines(lines, names)
+        native = parse_text_lines_native(lines, names)
+        assert [
+            (l.metric_name, l.value, l.timestamp) for l in native
+        ] == [(l.metric_name, l.value, l.timestamp) for l in py]
+
+
+# -- Hyperband bracket arithmetic -------------------------------------------
+
+
+class TestHyperbandProperties:
+    @settings(max_examples=200, deadline=None)
+    @given(st.integers(2, 6), st.integers(2, 729))
+    def test_rung_sizes_monotone_and_resources_reach_r_l(self, eta, r_l):
+        from katib_tpu.suggest.hyperband import HyperbandSuggester, _s_max
+
+        s_max = _s_max(float(r_l), eta)
+        assert eta**s_max <= r_l  # s_max definition
+        for s in range(s_max, -1, -1):
+            sizes = HyperbandSuggester._rung_sizes(s_max, s, eta)
+            assert len(sizes) == s + 1
+            assert all(a >= b >= 1 for a, b in zip(sizes, sizes[1:]))
+            # top rung always runs at the full resource budget
+            assert HyperbandSuggester._resource(float(r_l), eta, s, s) == int(r_l)
+            # resources grow monotonically up the rungs
+            rs = [HyperbandSuggester._resource(float(r_l), eta, s, i) for i in range(s + 1)]
+            assert all(a <= b for a, b in zip(rs, rs[1:]))
+
+
+# -- path-component safety ---------------------------------------------------
+
+
+class TestPathSafetyProperties:
+    @settings(max_examples=300, deadline=None)
+    @given(st.text(max_size=24))
+    def test_safe_names_never_escape_workdir(self, name):
+        """Whatever is accepted must stay strictly inside the workdir."""
+        import os
+
+        from katib_tpu.utils.names import is_safe_path_component
+
+        if not is_safe_path_component(name):
+            return
+        base = os.path.abspath("/w/dir")
+        joined = os.path.abspath(os.path.join(base, name))
+        assert joined.startswith(base + os.sep) and joined != base
