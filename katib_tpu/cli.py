@@ -5,11 +5,14 @@ The CLI replaces the reference's UI backend + kubectl surface
 metric logs) with local commands over the orchestrator's status journal and
 observation store:
 
-- ``run <experiment.yaml>``   create + run a (black-box) experiment to completion
+- ``run <experiment.yaml>``   create + run an experiment to completion (--resume)
 - ``list``                    experiments in the workdir with live counts
-- ``describe <experiment>``   trials, assignments, observations, optimal
+- ``describe <experiment>``   trials, assignments, observations, optimal, curve
 - ``metrics <trial>``         raw metric log for one trial
-- ``ui``                      serve the REST API + HTML dashboard
+- ``logs <trial>``            captured black-box stdout
+- ``export <experiment>``     trials as CSV/JSONL for analysis
+- ``ui``                      serve the REST API + HTML dashboard (TLS optional)
+- ``suggest-server``          suggestion-as-a-service daemon
 - ``conformance``             packaged e2e invariants check (conformance/run.sh parity)
 - ``doctor``                  environment report (devices, native runtime)
 """
@@ -183,6 +186,53 @@ def cmd_metrics(args: argparse.Namespace) -> int:
         return 1
     for l in logs:
         print(f"{l.timestamp:.3f}\t{l.step}\t{l.metric_name}\t{l.value}")
+    return 0
+
+
+def cmd_export(args: argparse.Namespace) -> int:
+    """Dump an experiment's trials as CSV or JSONL for analysis — flat
+    columns: trial, condition, one column per assignment, one per observed
+    metric (the strategy-reduced value the journal records)."""
+    from katib_tpu.orchestrator.status import read_status
+
+    s = read_status(args.workdir, args.experiment)
+    if s is None:
+        print(f"experiment {args.experiment!r} not found", file=sys.stderr)
+        return 1
+    rows = []
+    param_cols: list[str] = []
+    metric_cols: list[str] = []
+    for t in (s.get("trials") or {}).values():
+        row: dict = {"trial": t["name"], "condition": t["condition"]}
+        for k, v in (t.get("assignments") or {}).items():
+            col = f"param:{k}" if k in ("trial", "condition") else k
+            row[col] = v
+            if col not in param_cols:
+                param_cols.append(col)
+        for m in t.get("observation") or ():
+            # metrics get their own namespace when they'd shadow a reserved
+            # or parameter column (a metric literally named like a parameter
+            # would otherwise silently overwrite the assignment)
+            col = m["name"]
+            if col in ("trial", "condition") or col in param_cols:
+                col = f"metric:{col}"
+            row[col] = m["value"]
+            if col not in metric_cols:
+                metric_cols.append(col)
+        rows.append(row)
+    if args.format == "jsonl":
+        for row in rows:
+            print(json.dumps(row))
+        return 0
+    import csv
+
+    writer = csv.DictWriter(
+        sys.stdout,
+        fieldnames=["trial", "condition", *param_cols, *metric_cols],
+        extrasaction="ignore",
+    )
+    writer.writeheader()
+    writer.writerows(rows)
     return 0
 
 
@@ -417,6 +467,12 @@ def main(argv: list[str] | None = None) -> int:
     p = sub.add_parser("metrics", help="dump a trial's metric log")
     p.add_argument("trial")
     p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser("export", help="dump trials as CSV/JSONL for analysis")
+    p.add_argument("experiment")
+    p.add_argument("--format", choices=("csv", "jsonl"), default="csv")
+    p.add_argument("--workdir", default="katib_runs")
+    p.set_defaults(fn=cmd_export)
 
     p = sub.add_parser("logs", help="print a black-box trial's captured stdout")
     p.add_argument("trial")

@@ -203,6 +203,20 @@ spec:
         rc = main(["describe", "ghost", "--workdir", workdir])
         assert rc == 1
 
+        # export: CSV header + one row per trial, JSONL round-trips
+        rc = main(["export", "cli-exp", "--workdir", workdir])
+        out = capsys.readouterr().out
+        assert rc == 0
+        lines = [l for l in out.strip().splitlines() if l]
+        assert lines[0].startswith("trial,condition,x,loss")
+        assert len(lines) == 4  # header + 3 trials
+        rc = main(["export", "cli-exp", "--workdir", workdir, "--format", "jsonl"])
+        out = capsys.readouterr().out
+        rows = [json.loads(l) for l in out.strip().splitlines()]
+        assert len(rows) == 3 and all("loss" in r and "x" in r for r in rows)
+        rc = main(["export", "ghost", "--workdir", workdir])
+        assert rc == 1
+
     def test_run_without_command_errors(self, tmp_path, capsys):
         from katib_tpu.cli import main
 
