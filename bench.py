@@ -38,6 +38,21 @@ Env knobs:
                           the default: emit an honestly-labeled CPU
                           measurement (platform=cpu, tpu_unavailable=true,
                           vs_baseline/mfu nulled, reduced shapes recorded)
+  KATIB_REMOTE_COMPILE=1  compile on the terminal server instead of the
+                          default local AOT compile (see below; same knob
+                          as the scripts/ harnesses)
+
+Compile locality: the axon relay's terminal-side compile
+(``PALLAS_AXON_REMOTE_COMPILE=1``, the ambient default) ships the HLO to
+the pool and compiles there — measured at *minutes per trivial op* through
+the tunnel, and the full-size bilevel step's 100MB-class program wedged the
+session outright (round-2 attempt: 22 min, then a dead grant).  The same
+step compiles in ~35s client-side.  So the measurement child defaults to
+``PALLAS_AXON_REMOTE_COMPILE=0``: XLA compiles locally against the v5e
+target via the pip-installed ``libtpu.so`` (the plugin's documented
+local-AOT path) and only *execution* crosses the relay.  The env var must
+be set before interpreter start (the axon sitecustomize registers the PJRT
+plugin at boot), which is exactly what spawning a child process allows.
 """
 
 from __future__ import annotations
@@ -47,6 +62,9 @@ import os
 import subprocess
 import sys
 import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts"))
+from _common import remote_compile_requested  # noqa: E402
 
 _SMALL = os.environ.get("BENCH_SMALL", "") not in ("", "0")
 BATCH = 8 if _SMALL else 64
@@ -217,12 +235,20 @@ def _run_attempt(
 ) -> tuple[int, dict | None, str]:
     """One measurement attempt in a child process.  Returns
     (returncode, parsed result or None, stderr tail)."""
+    child_env = dict(os.environ if env is None else env)
+    # local AOT compile by default — the terminal-side compile path is both
+    # slow (minutes/op over the tunnel) and wedge-prone (see module doc).
+    # The ambient env exports PALLAS_AXON_REMOTE_COMPILE=1, so this must
+    # override, not setdefault; KATIB_REMOTE_COMPILE=1 restores remote.
+    child_env["PALLAS_AXON_REMOTE_COMPILE"] = (
+        "1" if remote_compile_requested() else "0"
+    )
     proc = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), "--child"],
         stdout=subprocess.PIPE,
         stderr=subprocess.PIPE,
         text=True,
-        env=env,
+        env=child_env,
     )
     try:
         out, err = proc.communicate(timeout=deadline)

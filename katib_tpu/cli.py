@@ -199,16 +199,22 @@ def cmd_export(args: argparse.Namespace) -> int:
     if s is None:
         print(f"experiment {args.experiment!r} not found", file=sys.stderr)
         return 1
-    rows = []
+    trials = list((s.get("trials") or {}).values())
+    # pass 1: the full parameter-column set, so metric renaming can't depend
+    # on trial order (a metric sharing a name with a parameter that only a
+    # LATER trial introduces must still land in the metric: namespace)
     param_cols: list[str] = []
-    metric_cols: list[str] = []
-    for t in (s.get("trials") or {}).values():
-        row: dict = {"trial": t["name"], "condition": t["condition"]}
-        for k, v in (t.get("assignments") or {}).items():
+    for t in trials:
+        for k in t.get("assignments") or {}:
             col = f"param:{k}" if k in ("trial", "condition") else k
-            row[col] = v
             if col not in param_cols:
                 param_cols.append(col)
+    rows = []
+    metric_cols: list[str] = []
+    for t in trials:
+        row: dict = {"trial": t["name"], "condition": t["condition"]}
+        for k, v in (t.get("assignments") or {}).items():
+            row[f"param:{k}" if k in ("trial", "condition") else k] = v
         for m in t.get("observation") or ():
             # metrics get their own namespace when they'd shadow a reserved
             # or parameter column (a metric literally named like a parameter

@@ -15,6 +15,34 @@ if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
 
+def ensure_local_compile() -> None:
+    """Re-exec with ``PALLAS_AXON_REMOTE_COMPILE=0`` if the ambient env asks
+    for terminal-side compile.
+
+    The axon sitecustomize registers the PJRT plugin at interpreter boot
+    with whatever the env said THEN, so flipping the variable here is too
+    late for this process — re-exec so the fresh interpreter registers the
+    local-AOT-compile path (XLA compiles against the pip-installed
+    ``libtpu.so`` client-side; only execution crosses the relay).  The
+    remote path was measured at minutes per trivial op through the tunnel
+    and wedged the session on the full-size bilevel program — see
+    ``bench.py``'s module doc.  ``KATIB_REMOTE_COMPILE=1`` opts back in.
+    """
+    if remote_compile_requested():
+        return
+    if os.environ.get("PALLAS_AXON_REMOTE_COMPILE") == "1":
+        os.environ["PALLAS_AXON_REMOTE_COMPILE"] = "0"
+        # orig_argv preserves interpreter options (-u, -m, -X ...) that
+        # sys.argv has already stripped
+        os.execve(sys.executable, list(sys.orig_argv), os.environ)
+
+
+def remote_compile_requested() -> bool:
+    """One opt-back-in knob for terminal-side compile, shared by bench.py
+    and the run scripts so the two surfaces can't drift."""
+    return os.environ.get("KATIB_REMOTE_COMPILE", "") not in ("", "0")
+
+
 def setup_jax(
     *,
     force_platform: str | None = None,
@@ -39,6 +67,8 @@ def setup_jax(
         ).strip()
     if force_platform is not None:
         os.environ["JAX_PLATFORMS"] = force_platform
+    elif os.environ.get("JAX_PLATFORMS") == "axon":
+        ensure_local_compile()  # may re-exec; no-op once the env is right
 
     import jax
 

@@ -1,0 +1,51 @@
+#!/bin/bash
+# Unattended TPU measurement session. The axon pool grants the chip to one
+# client at a time and a crashed session can leave a stale grant (claim
+# TTL, server-side) — so: probe until device init succeeds, then run the
+# measurement sequence with local AOT compile (see bench.py module doc).
+# Usage: bash scripts/tpu_session.sh [logfile]
+set -u
+cd "$(dirname "$0")/.."
+LOG="${1:-.tpu_session.log}"
+: > "$LOG"
+say() { echo "[tpu_session $(date +%H:%M:%S)] $*" | tee -a "$LOG"; }
+
+probe() {
+  PALLAS_AXON_REMOTE_COMPILE=0 timeout 330 python - <<'EOF' >>"$LOG" 2>&1
+import time, jax, jax.numpy as jnp
+t0 = time.time(); d = jax.devices()
+print("probe: init", round(time.time() - t0, 1), "s", d[0].platform, flush=True)
+t0 = time.time()
+y = (jnp.ones((512, 512)) @ jnp.ones((512, 512))).block_until_ready()
+print("probe: matmul", round(time.time() - t0, 2), "s sum", float(y.sum()), flush=True)
+EOF
+}
+
+say "waiting for TPU pool grant (probe every 150s, up to 3h)"
+ok=0
+for i in $(seq 1 72); do
+  if probe; then ok=1; say "pool grant acquired (attempt $i)"; break; fi
+  say "probe $i failed; pool still wedged — sleeping 150s"
+  sleep 150
+done
+if [ "$ok" != 1 ]; then say "pool never recovered; giving up"; exit 3; fi
+
+say "=== warm bench (full-size compile, local AOT) ==="
+BENCH_WARM_ONLY=1 BENCH_INIT_TIMEOUT=300 BENCH_RETRIES=2 BENCH_RETRY_BACKOFF=120 \
+  BENCH_NO_FALLBACK=1 python bench.py >>"$LOG" 2>&1
+say "warm bench rc=$?"
+
+say "=== timed bench ==="
+BENCH_INIT_TIMEOUT=300 BENCH_RETRIES=2 BENCH_RETRY_BACKOFF=120 BENCH_NO_FALLBACK=1 \
+  python bench.py > .bench_preview.json 2>>"$LOG"
+rc=$?
+say "timed bench rc=$rc: $(cat .bench_preview.json 2>/dev/null | head -c 400)"
+
+say "=== flagship DARTS search ==="
+python scripts/run_flagship_tpu.py >>"$LOG" 2>&1
+say "flagship rc=$?"
+
+say "=== long-context attention bench ==="
+python scripts/run_longcontext_tpu.py >>"$LOG" 2>&1
+say "longcontext rc=$?"
+say "session complete"

@@ -217,6 +217,46 @@ spec:
         rc = main(["export", "ghost", "--workdir", workdir])
         assert rc == 1
 
+    def test_export_metric_param_collision_is_order_independent(
+        self, tmp_path, capsys
+    ):
+        """A metric whose name collides with a parameter that only a LATER
+        trial introduces must still land in the metric: namespace (the
+        rename pre-scans all trials' parameters, so it can't depend on
+        trial iteration order)."""
+        from katib_tpu.cli import main
+
+        exp_dir = tmp_path / "col-exp"
+        exp_dir.mkdir()
+        (exp_dir / "status.json").write_text(json.dumps({
+            "name": "col-exp",
+            "condition": "MaxTrialsReached",
+            "trials": {
+                # trial 1 reports metric "y" and has no parameter "y"
+                "t1": {"name": "t1", "condition": "Succeeded",
+                       "assignments": {"x": 1},
+                       "observation": [{"name": "y", "value": 0.5}]},
+                # trial 2 introduces parameter "y" (e.g. a PBT mutation)
+                "t2": {"name": "t2", "condition": "Succeeded",
+                       "assignments": {"x": 2, "y": 7},
+                       "observation": [{"name": "y", "value": 0.25}]},
+            },
+        }))
+        rc = main(["export", "col-exp", "--workdir", str(tmp_path),
+                   "--format", "jsonl"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        rows = [json.loads(l) for l in out.strip().splitlines()]
+        # both trials' metrics use the SAME namespaced key; t2's parameter
+        # keeps the bare column
+        assert rows[0]["metric:y"] == 0.5 and "y" not in rows[0]
+        assert rows[1]["metric:y"] == 0.25 and rows[1]["y"] == 7
+
+        rc = main(["export", "col-exp", "--workdir", str(tmp_path)])
+        out = capsys.readouterr().out
+        header = out.strip().splitlines()[0].split(",")
+        assert rc == 0 and len(header) == len(set(header))  # no dup columns
+
     def test_run_without_command_errors(self, tmp_path, capsys):
         from katib_tpu.cli import main
 
