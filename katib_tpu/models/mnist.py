@@ -117,13 +117,16 @@ def train_classifier(
 
     test_acc = 0.0
     for epoch in range(epochs):
-        train_loss = 0.0
-        n = 0
+        # device futures, one transfer per epoch — per-step float() would
+        # host-sync every step and serialize async dispatch (see
+        # nas/darts/search.py)
+        step_losses = []
         for xb, yb in batches(dataset.x_train, dataset.y_train, batch_size, rng):
             batch = (xb, yb) if mesh is None else shard_batch((xb, yb), mesh)
             state, metrics = step(state, batch)
-            train_loss += float(metrics["loss"])
-            n += 1
+            step_losses.append(metrics["loss"])
+        n = len(step_losses)
+        train_loss = float(np.sum(jax.device_get(step_losses))) if n else 0.0
         # eval on a fixed prefix of the test split
         xe = dataset.x_test[:eval_batch]
         ye = dataset.y_test[:eval_batch]

@@ -36,6 +36,24 @@ from katib_tpu.nas.darts.ops import DEFAULT_PRIMITIVES
 from katib_tpu.parallel.mesh import replicate, shard_batch
 from katib_tpu.parallel.train import accuracy, cross_entropy_loss, make_eval_step
 
+_SEARCH_META = "search_meta.json"
+
+
+def _read_search_meta(checkpoint_dir: str) -> dict | None:
+    try:
+        with open(os.path.join(checkpoint_dir, _SEARCH_META)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _write_search_meta(checkpoint_dir: str, meta: dict) -> None:
+    path = os.path.join(checkpoint_dir, _SEARCH_META)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp, path)
+
 
 def run_darts_search(
     dataset: Dataset,
@@ -52,8 +70,16 @@ def run_darts_search(
     seed: int = 0,
     report=None,
     native_prefetch: bool | None = None,
+    checkpoint_dir: str | None = None,
 ) -> dict[str, Any]:
-    """Run the bilevel architecture search; returns genotype + final metrics."""
+    """Run the bilevel architecture search; returns genotype + final metrics.
+
+    ``checkpoint_dir``: when set, the search state (weights, alphas,
+    optimizer, velocity) is snapshotted through Orbax after every epoch and
+    the search resumes from the latest snapshot on restart — a long run on
+    a preemptible/flaky chip loses at most one epoch (the reference trial
+    image restarts its 50-epoch search from scratch, ``run_trial.py:148``).
+    """
     net = DartsNetwork(
         primitives=tuple(primitives),
         init_channels=init_channels,
@@ -99,6 +125,32 @@ def run_darts_search(
     state = init_search_state(weights, alphas, hyper)
     if mesh is not None:
         state = replicate(state, mesh)
+
+    ckpt = None
+    start_epoch = 0
+    resumed_history: list[dict] = []
+    resumed_best = 0.0
+    resumed_elapsed = 0.0
+    if checkpoint_dir is not None:
+        from katib_tpu.utils.checkpoint import TrialCheckpointer
+
+        ckpt = TrialCheckpointer(checkpoint_dir, max_to_keep=2)
+        latest = ckpt.latest_step()
+        if latest is not None:
+            state, _ = ckpt.restore(template=jax.device_get(state), step=latest)
+            start_epoch = latest  # step index == epochs completed
+            if mesh is not None:
+                state = replicate(state, mesh)
+            # sidecar carries what the pytree can't: the metric history and
+            # wallclock base, so a resumed run reports the FULL search (not
+            # just the post-restart epochs)
+            meta = _read_search_meta(checkpoint_dir)
+            if meta is not None and meta.get("epochs_completed") == latest:
+                resumed_history = [
+                    h for h in meta.get("history", ()) if h["epoch"] < latest
+                ]
+                resumed_best = float(meta.get("best_accuracy", 0.0))
+                resumed_elapsed = float(meta.get("elapsed_s", 0.0))
 
     # optional native prefetch: C++ worker threads gather the next shuffled
     # batch while the device runs the current bilevel step (enable with
@@ -151,25 +203,31 @@ def run_darts_search(
                     stacklevel=2,
                 )
 
-    best_acc = 0.0
-    history = []
-    t0 = time.perf_counter()
+    best_acc = resumed_best
+    history = list(resumed_history)
+    # time base continues across restarts so elapsed_s stays monotonic
+    t0 = time.perf_counter() - resumed_elapsed
     try:
-        for epoch in range(num_epochs):
+        for epoch in range(start_epoch, num_epochs):
             if native_loaders is not None:
                 w_stream = native_loaders[0].epoch()
                 a_stream = native_loaders[1].epoch()
             else:
                 w_stream = batches(x_w, y_w, batch_size, rng)
                 a_stream = batches(x_a, y_a, batch_size, rng)
-            train_loss = 0.0
-            steps = 0
+            # keep per-step losses as device futures: float()-ing inside the
+            # loop would block the host on every step and serialize the
+            # async dispatch pipeline (one device round-trip per step — on a
+            # tunneled chip that is the dominant cost); one transfer per
+            # epoch instead
+            step_losses = []
             for wb, ab in zip(w_stream, a_stream):
                 if mesh is not None:
                     wb, ab = shard_batch(wb, mesh), shard_batch(ab, mesh)
                 state, metrics = search_step(state, wb, ab)
-                train_loss += float(metrics["train_loss"])
-                steps += 1
+                step_losses.append(metrics["train_loss"])
+            steps = len(step_losses)
+            train_loss = float(np.sum(jax.device_get(step_losses))) if steps else 0.0
 
             ne = min(len(dataset.x_test), 1024)
             eval_batch = (dataset.x_test[:ne], dataset.y_test[:ne])
@@ -190,6 +248,19 @@ def run_darts_search(
                     "best_accuracy": best_acc,
                 }
             )
+            if ckpt is not None:
+                # step index = epochs completed; restore resumes at epoch
+                # `latest` with at most one epoch of lost work
+                ckpt.save(jax.device_get(state), epoch + 1)
+                _write_search_meta(
+                    checkpoint_dir,
+                    {
+                        "epochs_completed": epoch + 1,
+                        "best_accuracy": best_acc,
+                        "history": history,
+                        "elapsed_s": round(time.perf_counter() - t0, 3),
+                    },
+                )
             if report is not None:
                 cont = report(
                     epoch=epoch, accuracy=val_acc, loss=train_loss / max(steps, 1)

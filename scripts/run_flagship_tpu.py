@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import sys
 import time
 
@@ -56,6 +57,7 @@ def main() -> int:
         flush=True,
     )
 
+    ckpt_dir = os.path.join(REPO, ".flagship_ckpt")
     epoch_times: list[float] = []
     last = [time.perf_counter()]
 
@@ -82,8 +84,14 @@ def main() -> int:
         seed=0,
         report=report,
         native_prefetch=True,  # C++ batch gather overlaps device compute
+        # per-epoch Orbax snapshots: a relay drop mid-run resumes from the
+        # last completed epoch instead of restarting the search
+        checkpoint_dir=ckpt_dir,
     )
     wall = time.perf_counter() - t0
+    # completed: clear the snapshots so the next invocation is a fresh run
+    # (a leftover final-epoch checkpoint would make it a silent no-op)
+    shutil.rmtree(ckpt_dir, ignore_errors=True)
 
     steps_per_epoch = max(1, (len(dataset.x_train) // 2) // batch)
     total_steps = steps_per_epoch * epochs

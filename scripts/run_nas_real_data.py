@@ -1,0 +1,96 @@
+"""Real-data NAS evidence: DARTS bilevel search on the bundled UCI digits.
+
+The flagship CIFAR-10 runs use the structured synthetic fallback (zero
+egress — ``models/data.py``), so their accuracies prove the search loop,
+not learning.  This demo runs the SAME second-order bilevel search
+(``nas/darts/search.py``) on the one genuinely real dataset in the image
+(scikit-learn's ``load_digits``, 8x8 grayscale) and records search-phase
+validation accuracy + the discovered genotype in
+``artifacts/real_data/digits_nas.json`` — real-world evidence the NAS
+path finds architectures that classify real images.
+
+Sized for CPU: 4-layer / 8-channel / 2-node supernet over 1400 real
+digits.  Env knobs: NAS_EPOCHS (default 6), NAS_BATCH (64),
+NAS_SMALL=1 (smoke shapes for tests).
+
+Run: python scripts/run_nas_real_data.py   (CPU)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import setup_jax, write_artifact  # noqa: E402
+
+
+def main() -> int:
+    setup_jax(force_platform=os.environ.get("DEMO_PLATFORM", "cpu"))
+
+    small = os.environ.get("NAS_SMALL", "") not in ("", "0")
+    epochs = int(os.environ.get("NAS_EPOCHS", "1" if small else "6"))
+    batch = int(os.environ.get("NAS_BATCH", "16" if small else "64"))
+    num_layers = 2 if small else 4
+    init_channels = 4 if small else 8
+    n_nodes = 2
+
+    from katib_tpu.models.data import load_digits_real
+    from katib_tpu.nas.darts.architect import DartsHyper
+    from katib_tpu.nas.darts.search import run_darts_search
+
+    dataset = load_digits_real(n_train=256 if small else 1400)
+    history: list[dict] = []
+    t0 = time.perf_counter()
+
+    def report(epoch, accuracy, loss):
+        history.append(
+            {
+                "epoch": epoch,
+                "val_accuracy": round(float(accuracy), 4),
+                "elapsed_s": round(time.perf_counter() - t0, 1),
+            }
+        )
+        print(f"nas-real: epoch={epoch} val_acc={accuracy:.4f}", flush=True)
+        return True
+
+    result = run_darts_search(
+        dataset,
+        num_layers=num_layers,
+        init_channels=init_channels,
+        n_nodes=n_nodes,
+        num_epochs=epochs,
+        batch_size=batch,
+        hyper=DartsHyper(unrolled=True),
+        seed=0,
+        report=report,
+    )
+    wall = time.perf_counter() - t0
+
+    genotype = result["genotype"]
+    payload = {
+        "dataset": "sklearn load_digits (UCI handwritten digits, REAL data)",
+        "search": "DARTS second-order bilevel",
+        "config": {
+            "num_layers": num_layers,
+            "init_channels": init_channels,
+            "n_nodes": n_nodes,
+            "num_epochs": epochs,
+            "batch_size": batch,
+            "train_samples": int(len(dataset.x_train)),
+        },
+        "wallclock_s": round(wall, 1),
+        "best_val_accuracy": result["best_accuracy"],
+        "accuracy_vs_wallclock": history,
+        "genotype": {"normal": genotype.normal, "reduce": genotype.reduce},
+    }
+    if not small:
+        write_artifact("real_data", "digits_nas.json", payload)
+    print(json.dumps({k: payload[k] for k in ("best_val_accuracy", "wallclock_s")}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

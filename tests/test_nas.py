@@ -107,6 +107,30 @@ class TestDartsModel:
         assert out["history"][-1]["train_loss"] < out["history"][0]["train_loss"] * 1.2
         assert len(out["genotype"].normal) == 4
 
+    def test_search_resumes_from_checkpoint(self, tmp_path):
+        """A restarted search picks up at the last completed epoch (flaky
+        single-chip pools: a relay drop must not restart a long search)."""
+        from katib_tpu.models.data import synthetic_classification
+        from katib_tpu.nas.darts import DartsHyper, run_darts_search
+
+        ds = synthetic_classification(64, 32, (8, 8, 3), 4, seed=1, noise=0.3)
+        kw = dict(
+            primitives=TINY_PRIMS, num_layers=2, init_channels=4, n_nodes=2,
+            batch_size=32, hyper=DartsHyper(unrolled=False), seed=0,
+            checkpoint_dir=str(tmp_path / "ck"),
+        )
+        first = run_darts_search(ds, num_epochs=1, **kw)
+        assert [h["epoch"] for h in first["history"]] == [0]
+
+        second = run_darts_search(ds, num_epochs=3, **kw)
+        # epoch 0 was restored (sidecar history), 1..2 ran — the report
+        # covers the FULL search and time stays monotonic across restarts
+        assert [h["epoch"] for h in second["history"]] == [0, 1, 2]
+        assert second["history"][0] == first["history"][0]
+        elapsed = [h["elapsed_s"] for h in second["history"]]
+        assert elapsed == sorted(elapsed)
+        assert second["best_accuracy"] >= first["best_accuracy"]
+
 
 class TestDartsService:
     def test_single_trial_contract(self):
