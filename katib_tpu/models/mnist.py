@@ -127,9 +127,23 @@ def train_classifier(
             step_losses.append(metrics["loss"])
         n = len(step_losses)
         train_loss = float(np.sum(jax.device_get(step_losses))) if n else 0.0
-        # eval on a fixed prefix of the test split
-        xe = dataset.x_test[:eval_batch]
-        ye = dataset.y_test[:eval_batch]
+        # eval on a fixed prefix of the test split; under a mesh the prefix
+        # truncates to a multiple of the data-axis size (shard_batch's
+        # divisibility contract — 397 test rows on an 8-way axis would
+        # otherwise crash after the training epochs already ran)
+        ne = min(eval_batch, len(dataset.x_test))
+        xe = dataset.x_test[:ne]
+        ye = dataset.y_test[:ne]
+        if mesh is not None:
+            from katib_tpu.parallel.mesh import DATA_AXIS, local_mesh_size
+
+            d = local_mesh_size(mesh, DATA_AXIS)
+            if ne >= d:
+                xe, ye = xe[: (ne // d) * d], ye[: (ne // d) * d]
+            else:  # tiny split: tile up to one row per device
+                reps = -(-d // ne)
+                xe = np.tile(xe, (reps,) + (1,) * (xe.ndim - 1))[:d]
+                ye = np.tile(ye, reps)[:d]
         ebatch = (xe, ye) if mesh is None else shard_batch((xe, ye), mesh)
         em = evaluate(state.params, ebatch)
         test_acc = float(em["accuracy"])
