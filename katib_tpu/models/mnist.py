@@ -140,10 +140,11 @@ def train_classifier(
             d = local_mesh_size(mesh, DATA_AXIS)
             if ne >= d:
                 xe, ye = xe[: (ne // d) * d], ye[: (ne // d) * d]
-            else:  # tiny split: tile up to one row per device
+            elif ne > 0:  # tiny split: tile up to one row per device
                 reps = -(-d // ne)
                 xe = np.tile(xe, (reps,) + (1,) * (xe.ndim - 1))[:d]
                 ye = np.tile(ye, reps)[:d]
+            # ne == 0 shards fine (0 % d == 0) and evals to NaN
         ebatch = (xe, ye) if mesh is None else shard_batch((xe, ye), mesh)
         em = evaluate(state.params, ebatch)
         test_acc = float(em["accuracy"])

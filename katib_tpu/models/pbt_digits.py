@@ -1,0 +1,113 @@
+"""PBT workload with REAL model state: a digits classifier whose weights,
+momentum buffers, and step counter ride the PBT checkpoint lineage.
+
+The toy workload (``pbt_toy.py``, reference ``simple-pbt`` parity) carries
+one scalar through the lineage; this trial carries an actual JAX model —
+exploit clones the winner's Orbax checkpoint (parameters + momentum +
+step), explore perturbs the learning rate, and training *continues* from
+the inherited weights on the bundled REAL UCI digits.  That is the full
+PBT contract at model scale: the thing the reference moves between pods
+with ``shutil.copytree`` on a RWX PVC (``pbt/service.py:259-268``), here
+an Orbax pytree under the experiment workdir.
+
+Trial params: ``lr`` (the evolved hyperparameter), ``steps_per_round``
+(SGD minibatch steps per generation, default 60), ``batch`` (64).
+Reports ``accuracy`` on the held-out split once per round.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from katib_tpu.models.data import Dataset, load_digits_real
+
+_HIDDEN = 128
+
+# same in-process cache pattern as mnist._cached_mnist: a PBT sweep calls
+# this trial dozens of times per process; reload + re-permute each round
+# would be pure waste
+_DATASET_CACHE: dict[tuple, Dataset] = {}
+
+
+def _cached_digits(n_train: int, n_test: int) -> Dataset:
+    key = (n_train, n_test)
+    if key not in _DATASET_CACHE:
+        _DATASET_CACHE[key] = load_digits_real(n_train, n_test)
+    return _DATASET_CACHE[key]
+
+
+def _init_params(key: jax.Array, d_in: int, num_classes: int) -> dict:
+    k1, k2 = jax.random.split(key)
+    s1 = (2.0 / d_in) ** 0.5
+    s2 = (2.0 / _HIDDEN) ** 0.5
+    return {
+        "w1": s1 * jax.random.normal(k1, (d_in, _HIDDEN), jnp.float32),
+        "b1": jnp.zeros((_HIDDEN,), jnp.float32),
+        "w2": s2 * jax.random.normal(k2, (_HIDDEN, num_classes), jnp.float32),
+        "b2": jnp.zeros((num_classes,), jnp.float32),
+    }
+
+
+def _logits(params: dict, x: jnp.ndarray) -> jnp.ndarray:
+    h = jax.nn.relu(x @ params["w1"] + params["b1"])
+    return h @ params["w2"] + params["b2"]
+
+
+def _loss(params: dict, x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    logp = jax.nn.log_softmax(_logits(params, x))
+    return -jnp.take_along_axis(logp, y[:, None], axis=1).mean()
+
+
+@jax.jit
+def _sgd_step(params: dict, velocity: dict, x, y, lr):
+    grads = jax.grad(_loss)(params, x, y)
+    velocity = jax.tree_util.tree_map(lambda v, g: 0.9 * v + g, velocity, grads)
+    params = jax.tree_util.tree_map(lambda p, v: p - lr * v, params, velocity)
+    return params, velocity
+
+
+@jax.jit
+def _accuracy(params: dict, x, y):
+    return (jnp.argmax(_logits(params, x), axis=-1) == y).mean()
+
+
+def pbt_digits_trial(ctx) -> None:
+    lr = float(ctx.params["lr"])
+    steps_per_round = int(ctx.params.get("steps_per_round", 60))
+    batch = int(ctx.params.get("batch", 64))
+
+    ds = _cached_digits(1400, 397)
+    x_train = ds.x_train.reshape(len(ds.x_train), -1)
+    x_test = jnp.asarray(ds.x_test.reshape(len(ds.x_test), -1))
+    y_test = jnp.asarray(ds.y_test)
+
+    restored = ctx.restore_checkpoint()
+    if restored is not None:
+        state, _ = restored
+        params, velocity = state["params"], state["velocity"]
+        start = int(state["step"]) + 1
+    else:
+        params = _init_params(jax.random.PRNGKey(0), x_train.shape[1], 10)
+        velocity = jax.tree_util.tree_map(jnp.zeros_like, params)
+        start = 0
+
+    rng = np.random.default_rng(start)  # advance the data stream per round
+    step = start
+    for step in range(start, start + steps_per_round):
+        idx = rng.integers(0, len(x_train), size=batch)
+        params, velocity = _sgd_step(
+            params, velocity, jnp.asarray(x_train[idx]), jnp.asarray(ds.y_train[idx]), lr
+        )
+
+    acc = float(_accuracy(params, x_test, y_test))
+    ctx.report(step=step, accuracy=acc)
+    ctx.save_checkpoint(
+        {
+            "params": jax.device_get(params),
+            "velocity": jax.device_get(velocity),
+            "step": np.asarray(step),
+        },
+        step,
+    )

@@ -7,9 +7,11 @@ controller takes REINFORCE steps on the mean child validation accuracy
 (reference flow: ``enas/service.py:238`` sampling + ``:400`` reward
 aggregation + ``Controller.py:198`` trainer).
 
-The committed artifact ``artifacts/enas/demo_summary.json`` records the
-per-round mean reward so the controller's learning signal is inspectable,
-plus trials/hour and the best sampled architecture.
+The committed artifact records the per-round mean reward so the
+controller's learning signal is inspectable, plus trials/hour and the best
+sampled architecture: ``artifacts/enas/demo_summary.json`` for the default
+(synthetic-fallback CIFAR-10) children, ``artifacts/enas/digits_summary.json``
+when ``ENAS_DATASET=digits`` trains them on the bundled REAL UCI digits.
 
 Run: python scripts/run_enas_demo.py   (forces the CPU mesh; ENAS search is
 controller-on-CPU + child-on-mesh, same split as the reference)

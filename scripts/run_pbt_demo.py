@@ -1,14 +1,21 @@
-"""PBT demo: population evolving hyperparameters against a moving optimum.
+"""PBT demo: population evolving hyperparameters via the real PBT suggester
+— truncation selection, exploit-by-checkpoint-clone (the winner's Orbax
+state, fixing the reference's copy-the-loser quirk — ``suggest/pbt.py:17-21``),
+explore-by-perturb.
 
-Runs the simple-pbt workload (triangle-wave optimal learning rate,
-reference ``examples/v1beta1/trial-images/simple-pbt/pbt_test.py``) through
-the real PBT suggester — truncation selection, exploit-by-checkpoint-clone
-(the winner's Orbax state, fixing the reference's copy-the-loser quirk —
-``suggest/pbt.py:17-21``), explore-by-perturb — and writes
-``artifacts/pbt/demo_summary.json``: per-generation best/mean score, the
-lineage depth, and trials/hour.
+Two workloads, selected with ``PBT_DATASET``:
 
-Run: python scripts/run_pbt_demo.py   (CPU; PBT_PLATFORM overrides)
+- ``toy`` (default): the simple-pbt triangle-wave score (reference
+  ``examples/v1beta1/trial-images/simple-pbt/pbt_test.py``) →
+  ``artifacts/pbt/demo_summary.json``
+- ``digits``: a REAL digits classifier whose weights + momentum ride the
+  checkpoint lineage (``models/pbt_digits.py``) →
+  ``artifacts/pbt/digits_summary.json``
+
+Both record per-generation best/mean objective, lineage depth, trials/hour.
+
+Run: python scripts/run_pbt_demo.py   (CPU; PBT_PLATFORM overrides,
+PBT_POPULATION / PBT_GENERATIONS size the sweep)
 """
 
 from __future__ import annotations
@@ -44,12 +51,32 @@ def main() -> int:
     # compound — 8 generations gives surviving lineages room to separate
     population = int(os.environ.get("PBT_POPULATION", "8"))
     generations = int(os.environ.get("PBT_GENERATIONS", "8"))
+    # PBT_DATASET=digits evolves a REAL model (digits classifier whose
+    # weights + momentum ride the checkpoint lineage) instead of the toy
+    # scalar workload; see models/pbt_digits.py
+    dataset = os.environ.get("PBT_DATASET", "toy")
+    if dataset not in ("toy", "digits"):
+        print(f"PBT_DATASET must be 'toy' or 'digits', got {dataset!r}",
+              file=sys.stderr)
+        return 2
+    exp_name = "pbt-digits" if dataset == "digits" else "pbt-demo"
+    metric = "accuracy" if dataset == "digits" else "score"
     # lineage lives under the experiment workdir (durable across --resume,
     # not a leaked tempdir)
-    ckpt_dir = os.path.join(REPO, "katib_runs", "pbt-demo", "pbt-lineage")
+    ckpt_dir = os.path.join(REPO, "katib_runs", exp_name, "pbt-lineage")
+
+    if dataset == "digits":
+        from katib_tpu.models.pbt_digits import pbt_digits_trial as train_fn
+
+        # lr range wide enough that explore/exploit matters: the low end
+        # underfits in the per-round budget, the high end diverges
+        lr_space = FeasibleSpace(min=0.001, max=1.0)
+    else:
+        train_fn = pbt_toy_trial
+        lr_space = FeasibleSpace(min=0.0001, max=0.02)
 
     spec = ExperimentSpec(
-        name="pbt-demo",
+        name=exp_name,
         algorithm=AlgorithmSpec(
             name="pbt",
             settings={
@@ -59,16 +86,14 @@ def main() -> int:
             },
         ),
         objective=ObjectiveSpec(
-            type=ObjectiveType.MAXIMIZE, objective_metric_name="score"
+            type=ObjectiveType.MAXIMIZE, objective_metric_name=metric
         ),
         parameters=[
-            ParameterSpec(
-                "lr", ParameterType.DOUBLE, FeasibleSpace(min=0.0001, max=0.02)
-            ),
+            ParameterSpec("lr", ParameterType.DOUBLE, lr_space),
         ],
         max_trial_count=population * generations,
         parallel_trial_count=4,
-        train_fn=pbt_toy_trial,
+        train_fn=train_fn,
     )
     started = time.time()
     exp = Orchestrator(workdir=os.path.join(REPO, "katib_runs")).run(spec)
@@ -81,7 +106,7 @@ def main() -> int:
             continue
         gen = int(t.spec.labels.get("pbt-generation", 0))
         score = next(
-            (m.max for m in t.observation.metrics if m.name == "score"), None
+            (m.max for m in t.observation.metrics if m.name == metric), None
         )
         if score is not None:
             by_gen.setdefault(gen, []).append(score)
@@ -105,6 +130,8 @@ def main() -> int:
     summary = {
         "experiment": exp.spec.name,
         "condition": exp.condition.value,
+        "dataset": dataset,
+        "real_data": dataset == "digits",
         "platform": jax.devices()[0].platform,
         "population": population,
         "trials_total": len(exp.trials),
@@ -115,7 +142,11 @@ def main() -> int:
         "max_lineage_depth": lineage_depth,
         "score_per_generation": gen_curve,
     }
-    write_artifact("pbt", "demo_summary.json", summary)
+    write_artifact(
+        "pbt",
+        "digits_summary.json" if dataset == "digits" else "demo_summary.json",
+        summary,
+    )
     print(json.dumps({k: summary[k] for k in (
         "condition", "trials_total", "best_objective", "max_lineage_depth",
     )} | {"generations": gen_curve}), flush=True)

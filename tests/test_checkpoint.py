@@ -128,3 +128,41 @@ class TestPbtToyEndToEnd:
             t for t in exp.trials.values() if t.spec.labels.get("pbt-parent")
         ]
         assert parented, "no exploited members — truncation selection never fired"
+
+
+class TestPbtDigitsTrial:
+    def test_model_state_rides_the_lineage(self, tmp_path):
+        """The real-model PBT workload: a second round restores the first
+        round's weights/step and keeps improving — the exploit-clone
+        contract at model scale."""
+        from katib_tpu.models.pbt_digits import pbt_digits_trial
+        from katib_tpu.runner.context import TrialContext
+
+        reports: list[dict] = []
+
+        class Ctx:
+            params = {"lr": "0.2", "steps_per_round": "30"}
+            checkpoint_dir = str(tmp_path / "member0")
+            mesh = None
+            _checkpointer = None
+
+            def report(self, **kw):
+                reports.append(kw)
+                return True
+
+            # borrow the real checkpoint plumbing; only report() is faked
+            ensure_checkpoint_dir = TrialContext.ensure_checkpoint_dir
+            checkpointer = TrialContext.checkpointer
+            save_checkpoint = TrialContext.save_checkpoint
+            restore_checkpoint = TrialContext.restore_checkpoint
+
+        pbt_digits_trial(Ctx())
+        assert reports[-1]["step"] == 29
+        first_acc = reports[-1]["accuracy"]
+
+        ctx2 = Ctx()
+        ctx2._checkpointer = None
+        pbt_digits_trial(ctx2)
+        # continued from the inherited state: step advances past round 1
+        assert reports[-1]["step"] == 59
+        assert reports[-1]["accuracy"] >= first_acc - 0.05
