@@ -130,12 +130,18 @@ def _child() -> None:
     from katib_tpu.nas.darts.ops import DEFAULT_PRIMITIVES
     from katib_tpu.parallel.train import cross_entropy_loss
 
+    # remat off by default: at bench shapes the supernet fits HBM without
+    # recompute, and the bilevel step's 5 gradient passes make recompute
+    # expensive (the reference's torch trial does no remat either);
+    # BENCH_REMAT=1 restores it for memory-constrained configs
+    remat = os.environ.get("BENCH_REMAT", "") not in ("", "0")
     net = DartsNetwork(
         primitives=DEFAULT_PRIMITIVES,
         init_channels=INIT_CHANNELS,
         num_layers=NUM_LAYERS,
         n_nodes=N_NODES,
         num_classes=10,
+        remat=remat,
     )
     key = jax.random.PRNGKey(0)
     k_init, k_alpha, k_data = jax.random.split(key, 3)
@@ -224,6 +230,7 @@ def _child() -> None:
                     "num_layers": NUM_LAYERS,
                     "init_channels": INIT_CHANNELS,
                     "small_shapes": _SMALL,
+                    "remat": remat,
                 },
             }
         )

@@ -71,6 +71,7 @@ def run_darts_search(
     report=None,
     native_prefetch: bool | None = None,
     checkpoint_dir: str | None = None,
+    remat: bool = True,
 ) -> dict[str, Any]:
     """Run the bilevel architecture search; returns genotype + final metrics.
 
@@ -87,6 +88,11 @@ def run_darts_search(
         n_nodes=n_nodes,
         num_classes=dataset.num_classes,
         stem_multiplier=stem_multiplier,
+        # remat trades recompute for HBM; at CIFAR shapes a single v5e
+        # fits the supernet without it, and the bilevel step does 5
+        # gradient passes — skipping recompute is a real speedup when
+        # memory allows (remat=False)
+        remat=remat,
     )
     rng = np.random.default_rng(seed)
     key = jax.random.PRNGKey(seed)

@@ -43,6 +43,7 @@ def main() -> int:
     num_layers = 2 if small else 8
     init_channels = 4 if small else 16
     n_nodes = 2 if small else 4
+    remat = os.environ.get("FLAGSHIP_REMAT", "") not in ("", "0")
 
     from katib_tpu.models.data import load_cifar10, using_real_data
     from katib_tpu.nas.darts.architect import DartsHyper
@@ -87,6 +88,9 @@ def main() -> int:
         # per-epoch Orbax snapshots: a relay drop mid-run resumes from the
         # last completed epoch instead of restarting the search
         checkpoint_dir=ckpt_dir,
+        # fits HBM at these shapes without recompute (FLAGSHIP_REMAT=1 to
+        # restore for larger configs)
+        remat=remat,
     )
     wall = time.perf_counter() - t0
     # completed: clear the snapshots so the next invocation is a fresh run
@@ -122,6 +126,7 @@ def main() -> int:
             "batch_size": batch,
             "n_train": n_train,
             "second_order": True,
+            "remat": remat,
         },
         "platform": platform,
         "real_data": using_real_data("cifar10"),
