@@ -286,8 +286,9 @@ def main() -> None:
     attempt_timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "3600"))
 
     last_rc, last_err = 0, ""
+    env = None
     for attempt in range(1, retries + 1):
-        rc, result, err = _run_attempt(attempt_timeout)
+        rc, result, err = _run_attempt(attempt_timeout, env=env)
         if result is not None:
             print(json.dumps(result))
             return
@@ -299,6 +300,16 @@ def main() -> None:
             + (f"\n{err}" if err else ""),
             file=sys.stderr,
         )
+        if not wedged and os.environ.get("BENCH_REMAT", "") in ("", "0"):
+            # the child ran but crashed — plausibly HBM exhaustion from the
+            # no-recompute default; retry with activation checkpointing
+            print(
+                "bench: retrying with BENCH_REMAT=1 (activation recompute) "
+                "in case the failure was memory",
+                file=sys.stderr,
+            )
+            env = dict(os.environ)
+            env["BENCH_REMAT"] = "1"
         if attempt < retries:
             time.sleep(backoff)
     print(

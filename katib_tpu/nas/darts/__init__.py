@@ -1,4 +1,8 @@
 from katib_tpu.nas.darts.architect import DartsHyper, make_search_step  # noqa: F401
+from katib_tpu.nas.darts.augment import (  # noqa: F401
+    GenotypeNetwork,
+    train_genotype,
+)
 from katib_tpu.nas.darts.model import (  # noqa: F401
     Alphas,
     DartsNetwork,

@@ -1,0 +1,132 @@
+"""DARTS augment phase: train the discovered genotype as a fixed network.
+
+The reference trial image stops at printing ``Best-Genotype=...``
+(``darts-cnn-cifar10/run_trial.py:231-233``) — the genotype is the
+experiment's product, and actually *using* it is left to the user.  This
+module closes that loop: ``GenotypeNetwork`` materializes a discrete cell
+network from a :class:`~katib_tpu.nas.darts.model.Genotype` (each node =
+sum of its two kept ops, no mixed-op softmax), and ``train_genotype`` runs
+standard supervised training on it — the DARTS paper's "augment" stage,
+sized for whatever dataset the search ran on.
+
+The discrete network reuses the same primitive factory as the supernet
+(``ops.build_op``), so a genotype searched here trains on exactly the op
+implementations that were scored during search.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from katib_tpu.nas.darts.model import Genotype, run_macro
+from katib_tpu.nas.darts.ops import (
+    FactorizedReduce,
+    ReluConvBn,
+    build_op,
+)
+
+
+class GenotypeCell(nn.Module):
+    """One discrete cell: per node, the genotype's two kept ``(op, src)``
+    edges are applied and summed; the cell output concatenates the
+    intermediate nodes (reference cell layout, ``model.py:21``, with the
+    mixed op replaced by the chosen primitive)."""
+
+    gene: Sequence[Sequence[tuple]]  # per node: [(op_name, src_state), ...]
+    channels: int
+    reduction: bool = False
+    reduction_prev: bool = False
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, s0, s1):
+        if self.reduction_prev:
+            s0 = FactorizedReduce(self.channels, dtype=self.dtype)(s0)
+        else:
+            s0 = ReluConvBn(self.channels, dtype=self.dtype)(s0)
+        s1 = ReluConvBn(self.channels, dtype=self.dtype)(s1)
+
+        states = [s0, s1]
+        for node in self.gene:
+            total = None
+            for op_name, src in node:
+                # cell inputs shrink spatially in reduction cells; states
+                # computed inside the cell are already reduced
+                stride = 2 if self.reduction and src < 2 else 1
+                out = build_op(op_name, self.channels, stride, self.dtype)(
+                    states[src]
+                )
+                total = out if total is None else total + out
+            states.append(total)
+        return jnp.concatenate(states[2:], axis=-1)
+
+
+class GenotypeNetwork(nn.Module):
+    """Discrete-architecture classifier: stem + genotype cells with
+    reductions at 1/3 and 2/3 depth — the same macro-layout the supernet
+    searched (``model.py:74``)."""
+
+    genotype: Genotype
+    init_channels: int = 16
+    num_layers: int = 8
+    num_classes: int = 10
+    stem_multiplier: int = 3
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        def make_cell(c, reduction, reduction_prev):
+            gene = self.genotype.reduce if reduction else self.genotype.normal
+            return GenotypeCell(
+                gene=tuple(tuple(tuple(e) for e in node) for node in gene),
+                channels=c,
+                reduction=reduction,
+                reduction_prev=reduction_prev,
+                dtype=self.dtype,
+            )
+
+        return run_macro(
+            x,
+            make_cell,
+            init_channels=self.init_channels,
+            num_layers=self.num_layers,
+            num_classes=self.num_classes,
+            stem_multiplier=self.stem_multiplier,
+            dtype=self.dtype,
+        )
+
+
+def train_genotype(
+    genotype: Genotype,
+    dataset,
+    *,
+    init_channels: int = 16,
+    num_layers: int = 8,
+    lr: float = 0.025,
+    epochs: int = 10,
+    batch_size: int = 96,
+    mesh=None,
+    report=None,
+) -> float:
+    """Train the discrete network; returns final held-out accuracy."""
+    from katib_tpu.models.mnist import train_classifier
+
+    net = GenotypeNetwork(
+        genotype=genotype,
+        init_channels=init_channels,
+        num_layers=num_layers,
+        num_classes=dataset.num_classes,
+    )
+    return train_classifier(
+        net,
+        dataset,
+        lr=lr,
+        epochs=epochs,
+        batch_size=batch_size,
+        optimizer="momentum",
+        mesh=mesh,
+        report=report,
+    )

@@ -115,9 +115,48 @@ class Cell(nn.Module):
         return jnp.concatenate(states[2:], axis=-1)
 
 
+def run_macro(
+    x,
+    make_cell,
+    *,
+    init_channels: int,
+    num_layers: int,
+    num_classes: int,
+    stem_multiplier: int,
+    dtype,
+):
+    """The shared macro-skeleton (reference ``model.py:74`` NetworkCNN):
+    stem conv + BN, cells with channel-doubling reductions at 1/3 and 2/3
+    depth, global average pool, float32 classifier head.
+
+    ``make_cell(channels, reduction, reduction_prev) -> fn(s0, s1)``
+    supplies the per-layer cell — the supernet's mixed-op :class:`Cell` or
+    the augment phase's discrete ``GenotypeCell`` — so the two networks can
+    never drift apart in macro-architecture (must be called inside an
+    ``nn.compact`` ``__call__``; flax tracks the submodules it builds)."""
+    c_cur = init_channels * stem_multiplier
+    x = nn.Conv(c_cur, (3, 3), padding="SAME", use_bias=False, dtype=dtype)(
+        x.astype(dtype)
+    )
+    s0 = s1 = batch_norm(x)
+
+    c = init_channels
+    reduction_prev = False
+    reduction_layers = {num_layers // 3, 2 * num_layers // 3}
+    for layer in range(num_layers):
+        reduction = layer in reduction_layers and num_layers > 2
+        if reduction:
+            c *= 2
+        s0, s1 = s1, make_cell(c, reduction, reduction_prev)(s0, s1)
+        reduction_prev = reduction
+
+    out = jnp.mean(s1, axis=(1, 2))  # global average pool
+    return nn.Dense(num_classes, dtype=jnp.float32)(out.astype(jnp.float32))
+
+
 class DartsNetwork(nn.Module):
-    """Supernet (reference ``model.py:74`` NetworkCNN): stem + cells with
-    reductions at 1/3 and 2/3 depth, global pool, linear classifier."""
+    """Supernet (reference ``model.py:74`` NetworkCNN): the shared macro
+    skeleton with mixed-op cells."""
 
     primitives: Sequence[str] = DEFAULT_PRIMITIVES
     init_channels: int = 16
@@ -132,21 +171,9 @@ class DartsNetwork(nn.Module):
     def __call__(self, x, alphas: Alphas):
         w_normal = jax.nn.softmax(alphas.normal.astype(jnp.float32), axis=-1)
         w_reduce = jax.nn.softmax(alphas.reduce.astype(jnp.float32), axis=-1)
-
-        c_cur = self.init_channels * self.stem_multiplier
-        x = nn.Conv(
-            c_cur, (3, 3), padding="SAME", use_bias=False, dtype=self.dtype
-        )(x.astype(self.dtype))
-        s0 = s1 = batch_norm(x)
-
-        c = self.init_channels
-        reduction_prev = False
-        reduction_layers = {self.num_layers // 3, 2 * self.num_layers // 3}
         cell_cls = nn.remat(Cell) if self.remat else Cell
-        for layer in range(self.num_layers):
-            reduction = layer in reduction_layers and self.num_layers > 2
-            if reduction:
-                c *= 2
+
+        def make_cell(c, reduction, reduction_prev):
             cell = cell_cls(
                 primitives=self.primitives,
                 channels=c,
@@ -156,11 +183,17 @@ class DartsNetwork(nn.Module):
                 dtype=self.dtype,
             )
             weights = w_reduce if reduction else w_normal
-            s0, s1 = s1, cell(s0, s1, weights)
-            reduction_prev = reduction
+            return lambda s0, s1: cell(s0, s1, weights)
 
-        out = jnp.mean(s1, axis=(1, 2))  # global average pool
-        return nn.Dense(self.num_classes, dtype=jnp.float32)(out.astype(jnp.float32))
+        return run_macro(
+            x,
+            make_cell,
+            init_channels=self.init_channels,
+            num_layers=self.num_layers,
+            num_classes=self.num_classes,
+            stem_multiplier=self.stem_multiplier,
+            dtype=self.dtype,
+        )
 
 
 # ---------------------------------------------------------------------------

@@ -43,7 +43,10 @@ def main() -> int:
     num_layers = 2 if small else 8
     init_channels = 4 if small else 16
     n_nodes = 2 if small else 4
-    remat = os.environ.get("FLAGSHIP_REMAT", "") not in ("", "0")
+    # remat stays ON for the flagship: the unattended full-size bilevel
+    # run must not die to HBM exhaustion; FLAGSHIP_REMAT=0 opts into the
+    # faster no-recompute step once the config is known to fit
+    remat = os.environ.get("FLAGSHIP_REMAT", "1") not in ("", "0")
 
     from katib_tpu.models.data import load_cifar10, using_real_data
     from katib_tpu.nas.darts.architect import DartsHyper
@@ -88,8 +91,6 @@ def main() -> int:
         # per-epoch Orbax snapshots: a relay drop mid-run resumes from the
         # last completed epoch instead of restarting the search
         checkpoint_dir=ckpt_dir,
-        # fits HBM at these shapes without recompute (FLAGSHIP_REMAT=1 to
-        # restore for larger configs)
         remat=remat,
     )
     wall = time.perf_counter() - t0
