@@ -300,7 +300,11 @@ def main() -> None:
             + (f"\n{err}" if err else ""),
             file=sys.stderr,
         )
-        if not wedged and os.environ.get("BENCH_REMAT", "") in ("", "0"):
+        if (
+            attempt < retries
+            and not wedged
+            and os.environ.get("BENCH_REMAT", "") in ("", "0")
+        ):
             # the child ran but crashed — plausibly HBM exhaustion from the
             # no-recompute default; retry with activation checkpointing
             print(

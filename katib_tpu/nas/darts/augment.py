@@ -105,6 +105,7 @@ def train_genotype(
     *,
     init_channels: int = 16,
     num_layers: int = 8,
+    stem_multiplier: int = 3,
     lr: float = 0.025,
     epochs: int = 10,
     batch_size: int = 96,
@@ -119,6 +120,7 @@ def train_genotype(
         init_channels=init_channels,
         num_layers=num_layers,
         num_classes=dataset.num_classes,
+        stem_multiplier=stem_multiplier,
     )
     return train_classifier(
         net,

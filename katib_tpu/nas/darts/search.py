@@ -325,18 +325,27 @@ def darts_trial(ctx) -> None:
     def report(epoch, accuracy, loss):
         return ctx.report(step=epoch, accuracy=accuracy, loss=loss)
 
+    init_channels = int(settings.get("init_channels", 16))
+    batch_size = int(settings.get("batch_size", 128))
     result = run_darts_search(
         dataset,
         primitives=primitives,
         num_layers=num_layers,
-        init_channels=int(settings.get("init_channels", 16)),
+        init_channels=init_channels,
         n_nodes=int(settings.get("num_nodes", 4)),
         stem_multiplier=int(settings.get("stem_multiplier", 3)),
         num_epochs=int(settings.get("num_epochs", 10)),
-        batch_size=int(settings.get("batch_size", 128)),
+        batch_size=batch_size,
         hyper=hyper,
         mesh=ctx.mesh,
         report=report,
+        # per-epoch snapshots under the trial's checkpoint dir: a preempted
+        # trial re-runs from its last completed epoch, not from scratch
+        checkpoint_dir=(
+            os.path.join(ctx.checkpoint_dir, "search")
+            if ctx.checkpoint_dir
+            else None
+        ),
     )
     # the reference prints Best-Genotype= for the stdout scraper; we persist
     # the discrete architecture alongside the trial instead
@@ -350,4 +359,31 @@ def darts_trial(ctx) -> None:
             },
             f,
             indent=2,
+        )
+
+    # optional augment phase: train the discovered genotype as a fixed
+    # network and report its accuracy as a trial metric (setting
+    # ``augment_epochs`` > 0 turns it on; the reference has no equivalent —
+    # its trial ends at the printed genotype)
+    aug_epochs = int(settings.get("augment_epochs", 0))
+    if aug_epochs > 0:
+        from katib_tpu.nas.darts.augment import train_genotype
+
+        acc = train_genotype(
+            result["genotype"],
+            dataset,
+            init_channels=init_channels,
+            num_layers=num_layers,
+            stem_multiplier=int(settings.get("stem_multiplier", 3)),
+            lr=float(settings.get("augment_lr", 0.025)),
+            epochs=aug_epochs,
+            batch_size=batch_size,
+            mesh=ctx.mesh,
+        )
+        # step continues past the search epochs so the metric time-series
+        # stays monotonic (reporting at aug_epochs would rewind into the
+        # search's step range)
+        ctx.report(
+            step=int(settings.get("num_epochs", 10)) + aug_epochs,
+            augment_accuracy=float(acc),
         )

@@ -43,6 +43,8 @@ DEFAULT_SETTINGS: dict[str, object] = {
 }
 
 _POSITIVE_INT = {"num_epochs", "batch_size", "init_channels", "num_nodes", "stem_multiplier"}
+# augment_epochs may be 0 (off, the default); validated separately below
+_NON_NEGATIVE_INT = {"augment_epochs"}
 _POSITIVE_FLOAT = {
     "w_lr",
     "w_lr_min",
@@ -51,6 +53,7 @@ _POSITIVE_FLOAT = {
     "w_grad_clip",
     "alpha_lr",
     "alpha_weight_decay",
+    "augment_lr",
 }
 
 
@@ -83,13 +86,15 @@ class DartsSuggester(Suggester):
             raise SuggesterError("darts requires nas_config with operations")
         search_space_from_nas_config(spec.nas_config)
         for name, raw in spec.algorithm.settings.items():
-            if name in _POSITIVE_INT:
+            if name in _POSITIVE_INT or name in _NON_NEGATIVE_INT:
                 try:
                     v = int(raw)
                 except (TypeError, ValueError):
                     raise SuggesterError(f"{name} must be an integer") from None
-                if v <= 0:
+                if v <= 0 and name in _POSITIVE_INT:
                     raise SuggesterError(f"{name} must be > 0")
+                if v < 0:
+                    raise SuggesterError(f"{name} must be >= 0")
             elif name in _POSITIVE_FLOAT:
                 try:
                     v = float(raw)

@@ -126,6 +126,48 @@ class TestDartsModel:
         )
         assert acc > 0.3  # 4 classes, low noise: must beat chance clearly
 
+    def test_darts_trial_with_augment_reports_metric(self, tmp_path):
+        """The orchestrated trial path: search writes genotype.json into the
+        trial checkpoint dir, and augment_epochs > 0 trains the discovered
+        net and reports augment_accuracy."""
+        import json as _json
+
+        from katib_tpu.nas.darts.search import darts_trial
+        from katib_tpu.runner.context import TrialContext
+
+        reports: list[dict] = []
+
+        class Ctx:
+            params = {
+                "algorithm-settings": _json.dumps({
+                    "n_train": "128", "n_test": "64", "num_epochs": "1",
+                    "batch_size": "32", "init_channels": "4",
+                    "num_nodes": "2", "unrolled": "false",
+                    "augment_epochs": "1",
+                }),
+                "search-space": _json.dumps(list(TINY_PRIMS)),
+                "num-layers": "2",
+            }
+            checkpoint_dir = str(tmp_path / "trial0")
+            mesh = None
+            _checkpointer = None
+
+            def report(self, **kw):
+                reports.append(kw)
+                return True
+
+            ensure_checkpoint_dir = TrialContext.ensure_checkpoint_dir
+            checkpointer = TrialContext.checkpointer
+            save_checkpoint = TrialContext.save_checkpoint
+            restore_checkpoint = TrialContext.restore_checkpoint
+
+        darts_trial(Ctx())
+        geno = _json.loads((tmp_path / "trial0" / "genotype.json").read_text())
+        assert geno["normal"] and geno["reduce"]
+        assert any("augment_accuracy" in r for r in reports)
+        # the search snapshot landed under the trial dir (preemption resume)
+        assert (tmp_path / "trial0" / "search").is_dir()
+
     def test_search_resumes_from_checkpoint(self, tmp_path):
         """A restarted search picks up at the last completed epoch (flaky
         single-chip pools: a relay drop must not restart a long search)."""
