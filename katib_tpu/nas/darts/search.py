@@ -322,19 +322,26 @@ def darts_trial(ctx) -> None:
         overrides[name] = parse_bool(raw) if name == "unrolled" else float(raw)
     hyper = DartsHyper(**overrides)
 
+    stopped = [False]
+
     def report(epoch, accuracy, loss):
-        return ctx.report(step=epoch, accuracy=accuracy, loss=loss)
+        cont = ctx.report(step=epoch, accuracy=accuracy, loss=loss)
+        if not cont:
+            stopped[0] = True
+        return cont
 
     init_channels = int(settings.get("init_channels", 16))
     batch_size = int(settings.get("batch_size", 128))
+    stem_multiplier = int(settings.get("stem_multiplier", 3))
+    num_epochs = int(settings.get("num_epochs", 10))
     result = run_darts_search(
         dataset,
         primitives=primitives,
         num_layers=num_layers,
         init_channels=init_channels,
         n_nodes=int(settings.get("num_nodes", 4)),
-        stem_multiplier=int(settings.get("stem_multiplier", 3)),
-        num_epochs=int(settings.get("num_epochs", 10)),
+        stem_multiplier=stem_multiplier,
+        num_epochs=num_epochs,
         batch_size=batch_size,
         hyper=hyper,
         mesh=ctx.mesh,
@@ -366,7 +373,9 @@ def darts_trial(ctx) -> None:
     # ``augment_epochs`` > 0 turns it on; the reference has no equivalent —
     # its trial ends at the printed genotype)
     aug_epochs = int(settings.get("augment_epochs", 0))
-    if aug_epochs > 0:
+    if aug_epochs > 0 and not stopped[0]:
+        # an early-stopped search must not burn an augment budget the
+        # orchestrator already decided to reclaim
         from katib_tpu.nas.darts.augment import train_genotype
 
         acc = train_genotype(
@@ -374,7 +383,7 @@ def darts_trial(ctx) -> None:
             dataset,
             init_channels=init_channels,
             num_layers=num_layers,
-            stem_multiplier=int(settings.get("stem_multiplier", 3)),
+            stem_multiplier=stem_multiplier,
             lr=float(settings.get("augment_lr", 0.025)),
             epochs=aug_epochs,
             batch_size=batch_size,
@@ -383,7 +392,4 @@ def darts_trial(ctx) -> None:
         # step continues past the search epochs so the metric time-series
         # stays monotonic (reporting at aug_epochs would rewind into the
         # search's step range)
-        ctx.report(
-            step=int(settings.get("num_epochs", 10)) + aug_epochs,
-            augment_accuracy=float(acc),
-        )
+        ctx.report(step=num_epochs + aug_epochs, augment_accuracy=float(acc))

@@ -5,6 +5,7 @@ lazily by ``base.make_suggester`` so that plain HP-tuning experiments (and
 black-box orchestrator processes) never pay the JAX import/backend-init cost.
 """
 
+from katib_tpu.suggest import asha  # noqa: F401
 from katib_tpu.suggest import bayesopt  # noqa: F401
 from katib_tpu.suggest import cmaes  # noqa: F401
 from katib_tpu.suggest import grid  # noqa: F401

@@ -101,6 +101,30 @@ class Suggester(abc.ABC):
         ]
         return sorted(done, key=lambda t: t.start_time)
 
+    def top_trials(self, trials: list[Trial], k: int) -> list[Trial]:
+        """The k best trials by the experiment objective (missing
+        observations dropped).  Shared ranking rule for the
+        successive-halving family (hyperband, asha)."""
+        obj = self.spec.objective
+        scored = [(t.objective_value(obj), t) for t in trials]
+        scored = [(v, t) for v, t in scored if v is not None]
+        scored.sort(key=lambda p: p[0], reverse=obj.type.value == "maximize")
+        return [t for _, t in scored[:k]]
+
+    def rung_device_labels(self, r: int) -> dict[str, str]:
+        """``{DEVICES_LABEL: r}`` when the ``devices_per_rung`` setting is
+        truthy — the rung's resource value also sizes the trial's sub-mesh
+        lease (honored by the orchestrator's ElasticSliceAllocator), so
+        promoted survivors get more chips, not just more epochs.  One copy
+        of the setting parse for every rung-based suggester."""
+        if str(self.spec.algorithm.setting("devices_per_rung") or "").lower() in (
+            "1", "true", "yes",
+        ):
+            from katib_tpu.core.types import DEVICES_LABEL
+
+            return {DEVICES_LABEL: str(r)}
+        return {}
+
     @staticmethod
     def observed_xy(
         experiment: Experiment,

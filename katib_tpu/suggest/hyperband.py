@@ -136,13 +136,7 @@ class HyperbandSuggester(Suggester):
             if t.labels.get(S_LABEL) == str(s) and t.labels.get(I_LABEL) == str(i)
         ]
 
-    def _top_trials(self, trials: list[Trial], k: int) -> list[Trial]:
-        obj = self.spec.objective
-        scored = [(t.objective_value(obj), t) for t in trials]
-        scored = [(v, t) for v, t in scored if v is not None]
-        reverse = obj.type.value == "maximize"
-        scored.sort(key=lambda p: p[0], reverse=reverse)
-        return [t for _, t in scored[:k]]
+    # ranking shared with asha via Suggester.top_trials
 
     # -- main --------------------------------------------------------------
 
@@ -173,7 +167,7 @@ class HyperbandSuggester(Suggester):
                     raise SuggestionsNotReady(
                         f"hyperband bracket s={s} rung {i-1} still running"
                     )
-                survivors = self._top_trials(
+                survivors = self.top_trials(
                     [t for t in prev if t.condition.is_completed_ok()], sizes[i]
                 )
                 if not survivors:
@@ -215,14 +209,7 @@ class HyperbandSuggester(Suggester):
         orchestrator's ElasticSliceAllocator) — survivors get more chips,
         not just more epochs.  TPU-native elasticity the reference has no
         analog for (its ``r_i`` can only reach the container's argv)."""
-        labels = {S_LABEL: str(s), I_LABEL: str(i)}
-        if str(self.spec.algorithm.setting("devices_per_rung") or "").lower() in (
-            "1", "true", "yes",
-        ):
-            from katib_tpu.core.types import DEVICES_LABEL
-
-            labels[DEVICES_LABEL] = str(r)
-        return labels
+        return {S_LABEL: str(s), I_LABEL: str(i), **self.rung_device_labels(r)}
 
     def _master_rung(
         self,

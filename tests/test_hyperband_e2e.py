@@ -186,3 +186,63 @@ def test_devices_per_rung_scales_leases(tmp_path):
     ]
     assert grew, "no promotion increased the device budget"
     assert alloc.available() == alloc.n_devices
+
+
+def test_asha_async_sweep_e2e(tmp_path):
+    """ASHA through the orchestrator: asynchronous promotions (no rung
+    barrier), same reference e2e invariants, promotions present and the
+    resource parameter raised for promoted children."""
+
+    def train(ctx):
+        lr = float(ctx.params["lr"])
+        epochs = int(float(ctx.params["epochs"]))
+        base = 1.0 - (lr - 0.1) ** 2
+        for epoch in range(epochs):
+            acc = base * (1.0 - math.exp(-(epoch + 1) / 4.0))
+            if not ctx.report(step=epoch, accuracy=acc):
+                return
+
+    spec = ExperimentSpec(
+        name="asha-sweep",
+        algorithm=AlgorithmSpec(
+            name="asha",
+            settings={"r_max": "9", "r_min": "1", "eta": "3",
+                      "resource_name": "epochs"},
+        ),
+        objective=ObjectiveSpec(
+            type=ObjectiveType.MAXIMIZE, objective_metric_name="accuracy"
+        ),
+        parameters=[
+            ParameterSpec("lr", ParameterType.DOUBLE,
+                          FeasibleSpace(min=0.01, max=0.5)),
+            ParameterSpec("epochs", ParameterType.INT,
+                          FeasibleSpace(min=1, max=9)),
+        ],
+        max_trial_count=24,
+        parallel_trial_count=4,
+        train_fn=train,
+    )
+    exp = Orchestrator(workdir=str(tmp_path)).run(spec)
+
+    assert exp.condition in (
+        ExperimentCondition.MAX_TRIALS_REACHED,
+        ExperimentCondition.SUCCEEDED,
+    ), exp.message
+    assert exp.optimal is not None
+    assert exp.succeeded_count == 24
+
+    promoted = [t for t in exp.trials.values()
+                if t.labels.get("asha-parent")]
+    assert promoted, "no asynchronous promotions happened in 24 trials"
+    for t in promoted:
+        parent = exp.trials[t.labels["asha-parent"]]
+        child_r = int(float(next(a.value for a in t.spec.assignments
+                                 if a.name == "epochs")))
+        parent_r = int(float(next(a.value for a in parent.spec.assignments
+                                  if a.name == "epochs")))
+        assert child_r > parent_r  # promotion raises the resource
+        # and keeps the config: every non-resource assignment identical
+        child_lr = next(a.value for a in t.spec.assignments if a.name == "lr")
+        parent_lr = next(a.value for a in parent.spec.assignments
+                         if a.name == "lr")
+        assert child_lr == parent_lr
