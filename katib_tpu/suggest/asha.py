@@ -42,25 +42,18 @@ from katib_tpu.core.types import (
     Trial,
     TrialAssignmentSet,
 )
-from katib_tpu.suggest.base import Suggester, SuggesterError, register
+from katib_tpu.suggest.base import (
+    Suggester,
+    SuggesterError,
+    parse_eta,
+    register,
+)
 from katib_tpu.suggest.space import SpaceEncoder
 
 RUNG_LABEL = "asha-rung"
 PARENT_LABEL = "asha-parent"
 
 
-def _parse_eta(settings) -> int:
-    raw = settings.get("eta")
-    if raw is None:
-        return 3
-    try:
-        eta_f = float(raw)
-    except (TypeError, ValueError):
-        raise SuggesterError("eta must be an integer > 1") from None
-    eta = int(eta_f)
-    if eta != eta_f or eta <= 1:
-        raise SuggesterError("eta must be an integer > 1")
-    return eta
 
 
 @register("asha")
@@ -75,9 +68,12 @@ class AshaSuggester(Suggester):
             r_min = float(s.get("r_min", 1))
         except (TypeError, ValueError):
             raise SuggesterError("r_max/r_min must be numbers") from None
-        if r_min <= 0 or r_max < r_min:
-            raise SuggesterError("need 0 < r_min <= r_max")
-        _parse_eta(s)
+        # resources are integer trial budgets; a fractional r_min would
+        # clamp adjacent rungs to the same value and promotions would
+        # re-run configs at unchanged fidelity
+        if r_min < 1 or r_max < r_min:
+            raise SuggesterError("need 1 <= r_min <= r_max")
+        parse_eta(s)
         if not any(p.name == s["resource_name"] for p in spec.parameters):
             raise SuggesterError(
                 f"resource_name {s['resource_name']!r} must be a declared parameter"
@@ -89,7 +85,7 @@ class AshaSuggester(Suggester):
         s = self.spec.algorithm.settings
         r_max = float(s["r_max"])
         r_min = float(s.get("r_min", 1))
-        eta = _parse_eta(s)
+        eta = parse_eta(s)
         max_rung = int(math.floor(math.log(r_max / r_min) / math.log(eta) + 1e-9))
         return r_min, r_max, eta, max_rung, s["resource_name"]
 
@@ -170,25 +166,22 @@ class AshaSuggester(Suggester):
     ) -> list[TrialAssignmentSet]:
         _, _, eta, max_rung, resource_name = self._cfg()
         space = SpaceEncoder(self.spec.parameters)
+        # one scan per call: the promotion frontier, highest rung first so
+        # strong configs advance before new ones start.  Each trial appears
+        # in at most one rung's candidate list, so in-batch parent dedup is
+        # inherent.
+        frontier = [
+            (k, t)
+            for k in range(max_rung - 1, -1, -1)
+            for t in self._promotable(experiment, k, eta)
+        ]
         out: list[TrialAssignmentSet] = []
-        # promotions proposed in THIS batch also claim their parent
-        claimed: set[str] = set()
         n_rung0 = len(self._rung_trials(experiment, 0))
-        for _ in range(count):
-            promoted = False
-            # highest rung first: advance strong configs before seeding new ones
-            for k in range(max_rung - 1, -1, -1):
-                cands = [
-                    t
-                    for t in self._promotable(experiment, k, eta)
-                    if t.name not in claimed
-                ]
-                if cands:
-                    out.append(self._promote(cands[0], k + 1, resource_name))
-                    claimed.add(cands[0].name)
-                    promoted = True
-                    break
-            if not promoted:
+        for slot in range(count):
+            if slot < len(frontier):
+                k, t = frontier[slot]
+                out.append(self._promote(t, k + 1, resource_name))
+            else:
                 out.append(self._fresh(space, resource_name, index=n_rung0))
                 n_rung0 += 1
         return out

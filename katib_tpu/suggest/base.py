@@ -34,6 +34,22 @@ from katib_tpu.core.types import (
 )
 
 
+
+def parse_eta(settings) -> int:
+    """The successive-halving reduction factor: an integer > 1 (default 3).
+    One parser for hyperband and asha."""
+    raw = settings.get("eta")
+    if raw is None:
+        return 3
+    try:
+        eta_f = float(raw)
+    except (TypeError, ValueError):
+        raise SuggesterError("eta must be an integer > 1") from None
+    eta = int(eta_f)
+    if eta != eta_f or eta <= 1:
+        raise SuggesterError("eta must be an integer > 1")
+    return eta
+
 class SuggesterError(ValueError):
     """Invalid algorithm settings (gRPC INVALID_ARGUMENT analog)."""
 

@@ -37,6 +37,7 @@ from katib_tpu.core.types import (
     TrialAssignmentSet,
 )
 from katib_tpu.suggest.base import (
+    parse_eta,
     SearchExhausted,
     Suggester,
     SuggesterError,
@@ -50,18 +51,6 @@ S_LABEL = "hyperband-s"
 I_LABEL = "hyperband-i"
 
 
-def _parse_eta(settings) -> int:
-    raw = settings.get("eta")
-    if raw is None:
-        return 3
-    try:
-        eta_f = float(raw)
-    except (TypeError, ValueError):
-        raise SuggesterError("eta must be an integer > 1") from None
-    eta = int(eta_f)
-    if eta != eta_f or eta <= 1:
-        raise SuggesterError("eta must be an integer > 1")
-    return eta
 
 
 def _s_max(r_l: float, eta: int) -> int:
@@ -82,7 +71,7 @@ class HyperbandSuggester(Suggester):
             raise SuggesterError("r_l must be a positive number") from None
         if r_l <= 0:
             raise SuggesterError("r_l must be a positive number")
-        eta = _parse_eta(s)
+        eta = parse_eta(s)
         if not any(p.name == s["resource_name"] for p in spec.parameters):
             raise SuggesterError(
                 f"resource_name {s['resource_name']!r} must be a declared parameter"
@@ -99,7 +88,7 @@ class HyperbandSuggester(Suggester):
     def _cfg(self) -> tuple[float, int, int, str]:
         s = self.spec.algorithm.settings
         r_l = float(s["r_l"])
-        eta = _parse_eta(s)
+        eta = parse_eta(s)
         return r_l, eta, _s_max(r_l, eta), s["resource_name"]
 
     @staticmethod
