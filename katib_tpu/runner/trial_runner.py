@@ -23,6 +23,7 @@ jobs on experiment completion, ``experiment_controller.go:362-403``).
 from __future__ import annotations
 
 import os
+import re
 import subprocess
 import threading
 import time
@@ -127,15 +128,57 @@ def _run_whitebox(
     return _finalize(trial, store, objective)
 
 
-def substitute_command(command: list[str], params: dict) -> list[str]:
-    """Render ``${trialParameters.X}`` placeholders (reference
-    ``manifest/generator.go:99`` applyParameters)."""
-    out = []
-    for arg in command:
-        for name, value in params.items():
-            arg = arg.replace("${trialParameters.%s}" % name, str(value))
-        out.append(arg)
-    return out
+# one pattern for BOTH placeholder families so substitution is a single
+# simultaneous pass over the template text — substituted values can never
+# be re-expanded (a parameter value containing "${trialSpec...}" stays
+# verbatim, and a label value containing "${trialParameters...}" does too)
+_PLACEHOLDER = re.compile(
+    r"\$\{trialParameters\.([^}]+)\}"
+    r"|\$\{trialSpec\.([A-Za-z]+)(?:\[([^\]]+)\])?\}"
+)
+
+
+def _resolve_meta_ref(key: str, idx: str | None, raw: str, trial: Trial) -> str:
+    """Trial-metadata references (reference ``manifest/generator.go:148-171``:
+    Name/Namespace/Kind/APIVersion/Labels[k]/Annotations[k]).  TPU-native
+    mapping: Namespace -> the owning experiment (the closest scoping
+    construct), Kind/APIVersion -> this framework's type identity, and
+    Annotations resolve from the same label map (trials here carry one
+    metadata map, not two)."""
+    if key == "Name":
+        return trial.name
+    if key == "Namespace":
+        return trial.experiment_name
+    if key == "Kind":
+        return "Trial"
+    if key == "APIVersion":
+        return "katib-tpu/v1beta1"
+    if key in ("Labels", "Annotations"):
+        if idx is None or idx not in trial.spec.labels:
+            raise ValueError(
+                f"illegal trial metadata reference {raw}: "
+                f"trial has no label {idx!r}"
+            )
+        return trial.spec.labels[idx]
+    raise ValueError(f"illegal trial metadata reference {raw}")
+
+
+def substitute_command(
+    command: list[str], params: dict, trial: Trial | None = None
+) -> list[str]:
+    """Render ``${trialParameters.X}`` placeholders and — when the trial is
+    given — ``${trialSpec.*}`` metadata references (reference
+    ``manifest/generator.go:99`` applyParameters + meta keys :148-171)."""
+
+    def sub(m: "re.Match[str]") -> str:
+        if m.group(1) is not None:  # ${trialParameters.X}
+            name = m.group(1)
+            return str(params[name]) if name in params else m.group(0)
+        if trial is None:
+            return m.group(0)
+        return _resolve_meta_ref(m.group(2), m.group(3), m.group(0), trial)
+
+    return [_PLACEHOLDER.sub(sub, arg) for arg in command]
 
 
 class _LineSource:
@@ -282,7 +325,7 @@ def _run_blackbox(
 ) -> TrialResult:
     collector = trial.spec.metrics_collector
     metric_names = list(objective.all_metric_names())
-    argv = substitute_command(trial.spec.command, trial.params())
+    argv = substitute_command(trial.spec.command, trial.params(), trial)
     filters = [collector.filter] if collector.filter else []
     use_file = collector.path and collector.kind in (
         MetricsCollectorKind.FILE,

@@ -12,6 +12,7 @@ path to a ``train_fn(ctx)``) or by setting ``train_fn`` via the SDK.
 
 from __future__ import annotations
 
+import re
 from typing import Any, Mapping
 
 import yaml
@@ -211,22 +212,39 @@ def _command_from_trial_spec(template: Mapping[str, Any]) -> list[str] | None:
     argv = list(container.get("command") or []) + list(container.get("args") or [])
     if not argv:
         return None
+    return _apply_trial_parameter_renames(argv, template)
+
+
+# single simultaneous pass: sequential str.replace would chain when one
+# trialParameter's reference is another trialParameter's name
+_TRIAL_PARAM_REF = re.compile(r"\$\{trialParameters\.([^}]+)\}")
+
+
+def _apply_trial_parameter_renames(
+    argv: list, template: Mapping[str, Any]
+) -> list[str]:
+    """Rewrite ``${trialParameters.<name>}`` placeholders through the
+    template's ``trialParameters`` name->reference table (applies to flat
+    ``command`` templates and extracted K8s trialSpec argv alike)."""
     renames = {
         str(tp["name"]): str(tp["reference"])
         for tp in template.get("trialParameters") or ()
         if isinstance(tp, Mapping) and tp.get("name") and tp.get("reference")
     }
-    # single simultaneous pass: sequential str.replace would chain when one
-    # trialParameter's reference is another trialParameter's name
-    import re
-
-    pattern = re.compile(r"\$\{trialParameters\.([^}]+)\}")
+    if not renames:
+        return [str(token) for token in argv]
 
     def rewrite(m: "re.Match[str]") -> str:
         name = m.group(1)
-        return "${trialParameters." + renames.get(name, name) + "}"
+        ref = renames.get(name, name)
+        if ref.startswith("${trialSpec."):
+            # metadata reference (reference generator.go:148-171): keep the
+            # raw ${trialSpec.*} form — the trial runner resolves it against
+            # the materialized trial, not the parameter assignments
+            return ref
+        return "${trialParameters." + ref + "}"
 
-    return [pattern.sub(rewrite, str(token)) for token in argv]
+    return [_TRIAL_PARAM_REF.sub(rewrite, str(token)) for token in argv]
 
 
 def experiment_spec_from_dict(data: Mapping[str, Any]) -> ExperimentSpec:
@@ -268,6 +286,8 @@ def experiment_spec_from_dict(data: Mapping[str, Any]) -> ExperimentSpec:
     template = spec.get("trialTemplate") or {}
     if command is None:
         command = template.get("command")
+        if command is not None:
+            command = _apply_trial_parameter_renames(command, template)
     if command is None and template.get("trialSpec"):
         command = _command_from_trial_spec(template)
 

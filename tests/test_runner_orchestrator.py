@@ -153,6 +153,41 @@ class TestBlackboxRunner:
         )
         assert argv == ["python", "train.py", "--lr=0.01", "--u=32"]
 
+    def test_meta_reference_substitution(self):
+        """${trialSpec.*} metadata references resolve against the trial
+        (reference manifest/generator.go:148-171)."""
+        import pytest
+
+        trial = Trial(
+            name="exp-abc123",
+            experiment_name="exp",
+            spec=TrialSpec(
+                command=[],
+                assignments=[],
+                labels={"pbt-generation": "3"},
+            ),
+        )
+        argv = substitute_command(
+            ["--name=${trialSpec.Name}", "--ns=${trialSpec.Namespace}",
+             "--kind=${trialSpec.Kind}", "--gen=${trialSpec.Labels[pbt-generation]}",
+             "--also=${trialSpec.Annotations[pbt-generation]}"],
+            {}, trial,
+        )
+        assert argv == ["--name=exp-abc123", "--ns=exp", "--kind=Trial",
+                        "--gen=3", "--also=3"]
+        with pytest.raises(ValueError, match="no label"):
+            substitute_command(["${trialSpec.Labels[ghost]}"], {}, trial)
+        with pytest.raises(ValueError, match="illegal"):
+            substitute_command(["${trialSpec.Bogus}"], {}, trial)
+        # single-pass: substituted VALUES are never re-expanded — a
+        # parameter value carrying placeholder text passes through verbatim
+        argv = substitute_command(
+            ["--tmpl=${trialParameters.tmpl}"],
+            {"tmpl": "${trialSpec.Labels[ghost]}"},
+            trial,
+        )
+        assert argv == ["--tmpl=${trialSpec.Labels[ghost]}"]
+
     def _script_trial(self, code, params=None, rules=()):
         return Trial(
             name="bb1",
