@@ -22,6 +22,7 @@ jobs on experiment completion, ``experiment_controller.go:362-403``).
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import re
 import subprocess
@@ -324,6 +325,14 @@ def _run_blackbox(
     stop_event: threading.Event | None,
 ) -> TrialResult:
     collector = trial.spec.metrics_collector
+    # the collector path renders like the command (per-trial file paths via
+    # ${trialSpec.Name} keep parallel trials from clobbering each other's
+    # metrics; the reference gets this isolation from per-pod emptyDirs)
+    if collector.path:
+        collector = dataclasses.replace(
+            collector,
+            path=substitute_command([collector.path], trial.params(), trial)[0],
+        )
     metric_names = list(objective.all_metric_names())
     argv = substitute_command(trial.spec.command, trial.params(), trial)
     filters = [collector.filter] if collector.filter else []
