@@ -423,16 +423,57 @@ def cmd_ui(args: argparse.Namespace) -> int:
 
 
 def cmd_doctor(args: argparse.Namespace) -> int:
-    import jax
-
     from katib_tpu.native import build_error, native_available
 
-    print(f"jax {jax.__version__}")
+    # device init runs in a killable CHILD with a deadline: on a wedged
+    # accelerator pool (stale grant) ``jax.devices()`` blocks forever, and a
+    # diagnostic tool that hangs is worse than the condition it diagnoses
+    import subprocess
+
+    probe = (
+        "import json, os, time, jax\n"
+        # the axon PJRT plugin registers at interpreter boot and ignores
+        # JAX_PLATFORMS; honor it explicitly so JAX_PLATFORMS=cpu probes CPU
+        "want = os.environ.get('JAX_PLATFORMS')\n"
+        "jax.config.update('jax_platforms', want) if want else None\n"
+        "t0 = time.time(); d = jax.devices()\n"
+        "print(json.dumps({'n': len(d), 'platform': d[0].platform,"
+        " 'init_secs': round(time.time() - t0, 1)}))\n"
+    )
     try:
-        devices = jax.devices()
-        print(f"devices: {len(devices)} x {devices[0].platform}")
-    except RuntimeError as e:
-        print(f"devices: unavailable ({e})")
+        out = subprocess.run(
+            [sys.executable, "-c", probe],
+            capture_output=True,
+            text=True,
+            timeout=float(args.device_timeout),
+        )
+        info = None
+        if out.returncode == 0:
+            # a degraded environment may print banners around the JSON line;
+            # a parse failure is a diagnosis, not a doctor crash
+            lines = (out.stdout or "").strip().splitlines()
+            try:
+                info = json.loads(lines[-1]) if lines else None
+            except ValueError:
+                info = None
+        if info:
+            print(
+                f"devices: {info['n']} x {info['platform']} "
+                f"(init {info['init_secs']}s)"
+            )
+        else:
+            tail = (out.stderr or "").strip().splitlines()
+            print(f"devices: init failed rc={out.returncode}"
+                  + (f" ({tail[-1]})" if tail else ""))
+    except subprocess.TimeoutExpired:
+        print(
+            f"devices: init blocked > {args.device_timeout}s — accelerator "
+            "pool wedged (stale grant?); CPU-only work is unaffected, TPU "
+            "runs will recover when the pool releases the grant"
+        )
+    import jax
+
+    print(f"jax {jax.__version__}")
     if native_available():
         print("native runtime: built")
     else:
@@ -515,6 +556,12 @@ def main(argv: list[str] | None = None) -> int:
     p.set_defaults(fn=cmd_ui)
 
     p = sub.add_parser("doctor", help="environment report")
+    p.add_argument(
+        "--device-timeout",
+        default=30.0,
+        type=float,
+        help="seconds to wait for device init before declaring the pool wedged",
+    )
     p.set_defaults(fn=cmd_doctor)
 
     args = parser.parse_args(argv)

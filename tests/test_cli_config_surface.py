@@ -257,6 +257,19 @@ spec:
         header = out.strip().splitlines()[0].split(",")
         assert rc == 0 and len(header) == len(set(header))  # no dup columns
 
+    def test_doctor_reports_devices_with_deadline(self, capsys, monkeypatch):
+        """doctor probes device init in a killable child so a wedged pool
+        yields a diagnosis instead of a hang; healthy CPU path reports."""
+        from katib_tpu.cli import main
+
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        rc = main(["doctor", "--device-timeout", "60"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        # conftest forces the 8-device virtual CPU mesh
+        assert "x cpu (init" in out
+        assert "native runtime" in out
+
     def test_run_without_command_errors(self, tmp_path, capsys):
         from katib_tpu.cli import main
 

@@ -246,3 +246,56 @@ def test_asha_async_sweep_e2e(tmp_path):
         parent_lr = next(a.value for a in parent.spec.assignments
                          if a.name == "lr")
         assert child_lr == parent_lr
+
+
+def test_asha_devices_per_rung_scales_leases(tmp_path):
+    """asha's devices_per_rung: promoted children lease sub-meshes sized by
+    their rung resource, asynchronously (no bracket barrier)."""
+    from katib_tpu.parallel.distributed import ElasticSliceAllocator
+
+    seen: dict[str, int] = {}
+    lock = threading.Lock()
+
+    def train(ctx):
+        with lock:
+            seen[ctx.trial_name] = ctx.mesh.devices.size
+        acc = 1.0 - (float(ctx.params["lr"]) - 0.1) ** 2
+        for epoch in range(int(float(ctx.params["epochs"]))):
+            if not ctx.report(step=epoch, accuracy=acc * (epoch + 1)):
+                return
+
+    spec = ExperimentSpec(
+        name="asha-devices",
+        algorithm=AlgorithmSpec(
+            name="asha",
+            settings={
+                "r_max": "4", "eta": "2", "resource_name": "epochs",
+                "devices_per_rung": "true",
+            },
+        ),
+        objective=ObjectiveSpec(
+            type=ObjectiveType.MAXIMIZE, objective_metric_name="accuracy"
+        ),
+        parameters=[
+            ParameterSpec("lr", ParameterType.DOUBLE,
+                          FeasibleSpace(min=0.01, max=0.5)),
+            ParameterSpec("epochs", ParameterType.INT,
+                          FeasibleSpace(min=1, max=4)),
+        ],
+        max_trial_count=16,
+        parallel_trial_count=4,
+        train_fn=train,
+    )
+    alloc = ElasticSliceAllocator(devices=jax.devices())
+    exp = Orchestrator(workdir=str(tmp_path), slice_allocator=alloc).run(spec)
+    assert exp.succeeded_count == 16
+    for t in exp.trials.values():
+        want = int(float(t.params()["epochs"]))
+        assert seen[t.name] == min(want, alloc.n_devices), (t.name, want)
+    grew = [
+        t for t in exp.trials.values()
+        if t.labels.get("asha-parent")
+        and seen[t.name] > seen[t.labels["asha-parent"]]
+    ]
+    assert grew, "no asha promotion increased the device budget"
+    assert alloc.available() == alloc.n_devices
