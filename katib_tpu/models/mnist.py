@@ -85,13 +85,22 @@ def train_classifier(
     seed: int = 0,
     report=None,
     eval_batch: int = 1024,
+    init_transform=None,
+    on_finish=None,
 ) -> float:
     """Train and return final test accuracy; calls ``report(epoch, acc, loss)``
-    per epoch when given (the trial metrics hook)."""
+    per epoch when given (the trial metrics hook).
+
+    ``init_transform(params) -> params`` warm-starts the freshly initialized
+    parameters (ENAS weight sharing); ``on_finish(params)`` receives the
+    final parameters (publishing back to a shared pool)."""
     rng = np.random.default_rng(seed)
     params = model.init(
         jax.random.PRNGKey(seed), jnp.zeros((1, *dataset.input_shape), jnp.float32)
     )
+    if init_transform is not None:
+        # warm starts (e.g. ENAS weight sharing overlays the shared pool)
+        params = init_transform(params)
     tx = make_optimizer(optimizer, lr, momentum)
     state = TrainState.create(params, tx)
 
@@ -156,6 +165,8 @@ def train_classifier(
             )
             if cont is False:
                 break
+    if on_finish is not None:
+        on_finish(jax.device_get(state.params))
     return test_acc
 
 

@@ -35,6 +35,7 @@ from katib_tpu.nas.darts.model import (
 from katib_tpu.nas.darts.ops import DEFAULT_PRIMITIVES
 from katib_tpu.parallel.mesh import replicate, shard_batch
 from katib_tpu.parallel.train import accuracy, cross_entropy_loss, make_eval_step
+from katib_tpu.utils.booleans import parse_bool
 
 _SEARCH_META = "search_meta.json"
 
@@ -305,11 +306,6 @@ def darts_trial(ctx) -> None:
     primitives = tuple(json.loads(ctx.params.get("search-space", "null")) or DEFAULT_PRIMITIVES)
     num_layers = int(ctx.params.get("num-layers", 8))
 
-    def parse_bool(raw, default=True):
-        if isinstance(raw, bool):
-            return raw
-        return str(raw).strip().lower() not in ("false", "0", "no", "none", "")
-
     n_train = int(settings.get("n_train", 8192))
     dataset = load_cifar10(n_train, int(settings.get("n_test", 2048)))
     # DartsHyper's field defaults are the single source of truth; settings
@@ -319,7 +315,7 @@ def darts_trial(ctx) -> None:
         if name == "total_steps" or name not in settings:
             continue
         raw = settings[name]
-        overrides[name] = parse_bool(raw) if name == "unrolled" else float(raw)
+        overrides[name] = parse_bool(raw, default=True) if name == "unrolled" else float(raw)
     hyper = DartsHyper(**overrides)
 
     stopped = [False]

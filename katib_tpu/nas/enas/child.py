@@ -88,7 +88,16 @@ class EnasChild(nn.Module):
             used = [outputs[j] for j, s in enumerate(skips) if s]
             if used:
                 inp = jnp.concatenate([inp, *used], axis=-1)
-            x = _Op(self.operations[op_idx], self.channels, dtype=self.dtype)(inp)
+            # op-qualified module name: weight-sharing pools key parameters
+            # by flax path, and e.g. avg/max pooling have identically-shaped
+            # 1x1 projections — the op name in the path keeps each op's
+            # weights separate per layer (the ENAS paper's per-op pool)
+            x = _Op(
+                self.operations[op_idx],
+                self.channels,
+                dtype=self.dtype,
+                name=f"op{layer}_{self.operations[op_idx]}",
+            )(inp)
             outputs.append(x)
             if (layer + 1) % self.pool_every == 0:
                 x = nn.max_pool(x, (2, 2), strides=(2, 2))

@@ -1,0 +1,69 @@
+"""ENAS weight sharing: children inherit a shared parameter pool.
+
+The ENAS paper's core efficiency idea (Pham et al. 2018, §2) is that child
+models SHARE weights — a sampled architecture trains the shared pool, and
+the next child starts from it instead of from scratch.  The reference
+never implements this: its child trainer builds a fresh Keras model per
+trial (``enas-cnn-cifar10/RunTrial.py:52``), so every trial pays full
+training cost and the controller's reward signal is noisy early-training
+accuracy.  Here sharing is an opt-in trial parameter (``weight_sharing``)
+that makes each child overlay the pool's parameters before training and
+publish its trained parameters back afterwards.
+
+Sharing is **by module path + shape**: a child's parameter is inherited
+when the pool has a leaf at the same flax path with the same shape/dtype.
+Layer ``i``'s op module is named ``op{i}_{op_name}`` (child.py), so the
+pool holds separate weights per (layer, op) — the ENAS paper's per-op
+pool — and a skip-dependent input-width mismatch simply re-initializes
+that leaf.  Write-back is last-writer-wins
+under a process-wide lock — trials run as threads of one orchestrator, so
+the lock is sufficient, and ENAS's shared pool is explicitly a lossy
+communal resource (the paper updates it concurrently from sampled archs).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from flax import traverse_util
+
+from katib_tpu.utils.checkpoint import TrialCheckpointer
+
+_LOCK = threading.Lock()
+
+
+def overlay_matching(params: Any, shared: Any) -> tuple[Any, int]:
+    """Replace every leaf of ``params`` whose path + shape + dtype match a
+    leaf of ``shared``; returns ``(new_params, n_inherited)``."""
+    flat_p = traverse_util.flatten_dict(params)
+    flat_s = traverse_util.flatten_dict(shared)
+    n = 0
+    for key, value in flat_p.items():
+        cand = flat_s.get(key)
+        if (
+            cand is not None
+            and getattr(cand, "shape", None) == getattr(value, "shape", ())
+            and getattr(cand, "dtype", None) == getattr(value, "dtype", None)
+        ):
+            flat_p[key] = cand
+            n += 1
+    return traverse_util.unflatten_dict(flat_p), n
+
+
+def load_pool(directory: str) -> Any | None:
+    """Latest shared-pool pytree, or None when no pool exists yet."""
+    with _LOCK:
+        ckpt = TrialCheckpointer(directory, max_to_keep=2)
+        restored = ckpt.restore()
+        return None if restored is None else restored[0]
+
+
+def publish_pool(directory: str, params: Any) -> None:
+    """Publish trained parameters as the new pool version (last-writer-wins)."""
+    import jax
+
+    with _LOCK:
+        ckpt = TrialCheckpointer(directory, max_to_keep=2)
+        latest = ckpt.latest_step()
+        ckpt.save(jax.device_get(params), 1 if latest is None else latest + 1)

@@ -53,6 +53,36 @@ def enas_trial(ctx) -> None:
     def report(epoch, accuracy, loss):
         return ctx.report(step=epoch, accuracy=accuracy, loss=loss)
 
+    # opt-in ENAS weight sharing (the paper's core efficiency idea, which
+    # the reference never implements): children overlay the experiment's
+    # shared parameter pool before training and publish back afterwards
+    from katib_tpu.utils.booleans import parse_bool
+
+    init_transform = on_finish = None
+    share = parse_bool(ctx.params.get("weight_sharing"))
+    if share and ctx.checkpoint_dir:
+        import os
+
+        from katib_tpu.nas.enas.shared import (
+            load_pool,
+            overlay_matching,
+            publish_pool,
+        )
+
+        pool_dir = os.path.join(
+            os.path.dirname(ctx.checkpoint_dir), "enas-shared"
+        )
+        pool = load_pool(pool_dir)
+
+        def init_transform(params, _pool=pool):
+            if _pool is None:
+                return params
+            merged, _ = overlay_matching(params, _pool)
+            return merged
+
+        def on_finish(params):
+            publish_pool(pool_dir, params)
+
     train_classifier(
         model,
         dataset,
@@ -62,4 +92,6 @@ def enas_trial(ctx) -> None:
         optimizer="momentum",
         mesh=ctx.mesh,
         report=report,
+        init_transform=init_transform,
+        on_finish=on_finish,
     )

@@ -346,6 +346,56 @@ class TestEnasService:
         assert s2.round == 1
 
 
+class TestEnasWeightSharing:
+    def test_child_inherits_pool_and_publishes_back(self, tmp_path):
+        """weight_sharing: a child overlays the shared pool before training
+        (same arc => starts at the previous child's final accuracy) and
+        publishes its trained parameters back."""
+        import json as _json
+
+        from katib_tpu.nas.enas.trial import enas_trial
+
+        runs: list[list[dict]] = []
+
+        def make_ctx(trial_dir):
+            reports: list[dict] = []
+            runs.append(reports)
+
+            class Ctx:
+                params = {
+                    "architecture": _json.dumps([[0], [1, 1]]),
+                    "nn_config": _json.dumps({"num_layers": 2}),
+                    "dataset": "digits",
+                    # enough steps that the first child actually learns —
+                    # the assertion needs accuracy daylight between a cold
+                    # and a warm start
+                    "num_epochs": "5",
+                    "batch_size": "64",
+                    "channels": "8",
+                    "weight_sharing": "true",
+                }
+                checkpoint_dir = str(trial_dir)
+                mesh = None
+                _checkpointer = None
+
+                def report(self, **kw):
+                    reports.append(kw)
+                    return True
+
+            return Ctx()
+
+        exp_dir = tmp_path / "exp"
+        enas_trial(make_ctx(exp_dir / "t1"))
+        assert (exp_dir / "enas-shared").is_dir()
+        first_final = runs[0][-1]["accuracy"]
+
+        enas_trial(make_ctx(exp_dir / "t2"))
+        # identical arc -> full overlay -> epoch 0 is at least as good as
+        # the first child's final epoch (minus a little SGD wobble)
+        assert runs[1][0]["accuracy"] >= first_final - 0.05
+        assert runs[1][0]["accuracy"] > runs[0][0]["accuracy"] + 0.05
+
+
 class TestNativePrefetchSearch:
     def test_search_with_native_loader(self):
         """run_darts_search(native_prefetch=True) streams batches through the
