@@ -162,3 +162,32 @@ def batches(
         if drop_remainder and len(take) < batch_size:
             break
         yield x[take], y[take]
+
+
+# name -> loader dispatch shared by the NAS trials (enas/trial.py,
+# darts/search.py): one place for per-dataset split defaults and the
+# accepted-names error
+NAMED_DATASETS = ("cifar10", "digits")
+
+
+def load_named_dataset(
+    name: str, n_train: int | None = None, n_test: int | None = None
+) -> Dataset:
+    """``"digits"`` = the bundled REAL dataset (UCI handwritten digits);
+    ``"cifar10"`` = the CIFAR-10 loader (real npz via ``KATIB_DATA_DIR``,
+    structured synthetic fallback otherwise).  Split defaults are
+    per-dataset: digits has only 1797 samples, so CIFAR-scale defaults
+    would clamp its test split to nothing."""
+    if name == "digits":
+        return load_digits_real(
+            1400 if n_train is None else n_train,
+            397 if n_test is None else n_test,
+        )
+    if name == "cifar10":
+        return load_cifar10(
+            8192 if n_train is None else n_train,
+            2048 if n_test is None else n_test,
+        )
+    raise ValueError(
+        f"unknown dataset {name!r} (expected one of {NAMED_DATASETS})"
+    )

@@ -306,8 +306,16 @@ def darts_trial(ctx) -> None:
     primitives = tuple(json.loads(ctx.params.get("search-space", "null")) or DEFAULT_PRIMITIVES)
     num_layers = int(ctx.params.get("num-layers", 8))
 
-    n_train = int(settings.get("n_train", 8192))
-    dataset = load_cifar10(n_train, int(settings.get("n_test", 2048)))
+    # same dataset knob as the ENAS trial (models/data.py dispatch)
+    from katib_tpu.models.data import load_named_dataset
+
+    n_train = settings.get("n_train")
+    n_test = settings.get("n_test")
+    dataset = load_named_dataset(
+        str(settings.get("dataset", "cifar10")),
+        int(n_train) if n_train is not None else None,
+        int(n_test) if n_test is not None else None,
+    )
     # DartsHyper's field defaults are the single source of truth; settings
     # override field-by-field (total_steps is derived from the schedule)
     overrides = {}

@@ -102,6 +102,14 @@ class DartsSuggester(Suggester):
                     raise SuggesterError(f"{name} must be a number") from None
                 if v < 0:
                     raise SuggesterError(f"{name} must be >= 0")
+            elif name == "dataset":
+                from katib_tpu.models.data import NAMED_DATASETS
+
+                if str(raw) not in NAMED_DATASETS:
+                    # a typo must fail at submission, not after the search
+                    raise SuggesterError(
+                        f"dataset must be one of {NAMED_DATASETS}, got {raw!r}"
+                    )
 
     def merged_settings(self) -> dict:
         merged = dict(DEFAULT_SETTINGS)

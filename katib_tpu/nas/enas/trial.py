@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import json
 
-from katib_tpu.models.data import load_cifar10, load_digits_real
+from katib_tpu.models.data import load_named_dataset
 from katib_tpu.models.mnist import train_classifier
 from katib_tpu.nas.enas.child import child_from_arc
 from katib_tpu.nas.enas.controller import arc_from_json
@@ -31,24 +31,13 @@ def enas_trial(ctx) -> None:
         num_classes=int(ctx.params.get("num_classes", 10)),
         **kwargs,
     )
-    # "digits" = the bundled REAL dataset (UCI handwritten digits); default
-    # stays the CIFAR-10 loader (real npz when KATIB_DATA_DIR provides it,
-    # structured synthetic fallback otherwise)
-    ds_name = ctx.params.get("dataset", "cifar10")
-    if ds_name == "digits":
-        # digits has 1797 samples total — CIFAR-scale defaults would clamp
-        # the test split to 1 sample and make accuracy a coin flip
-        n_train = int(ctx.params.get("n_train", 1400))
-        n_test = int(ctx.params.get("n_test", 397))
-        dataset = load_digits_real(n_train, n_test)
-    elif ds_name == "cifar10":
-        n_train = int(ctx.params.get("n_train", 8192))
-        n_test = int(ctx.params.get("n_test", 2048))
-        dataset = load_cifar10(n_train, n_test)
-    else:
-        raise ValueError(
-            f"unknown dataset {ds_name!r} (expected 'cifar10' or 'digits')"
-        )
+    n_train = ctx.params.get("n_train")
+    n_test = ctx.params.get("n_test")
+    dataset = load_named_dataset(
+        str(ctx.params.get("dataset", "cifar10")),
+        int(n_train) if n_train is not None else None,
+        int(n_test) if n_test is not None else None,
+    )
 
     def report(epoch, accuracy, loss):
         return ctx.report(step=epoch, accuracy=accuracy, loss=loss)
