@@ -178,16 +178,17 @@ def load_named_dataset(
     structured synthetic fallback otherwise).  Split defaults are
     per-dataset: digits has only 1797 samples, so CIFAR-scale defaults
     would clamp its test split to nothing."""
+    # only pass what the caller specified — the loaders' own signature
+    # defaults (digits 1400/397, cifar 8192/2048) stay the single source
+    kwargs = {}
+    if n_train is not None:
+        kwargs["n_train"] = n_train
+    if n_test is not None:
+        kwargs["n_test"] = n_test
     if name == "digits":
-        return load_digits_real(
-            1400 if n_train is None else n_train,
-            397 if n_test is None else n_test,
-        )
+        return load_digits_real(**kwargs)
     if name == "cifar10":
-        return load_cifar10(
-            8192 if n_train is None else n_train,
-            2048 if n_test is None else n_test,
-        )
+        return load_cifar10(**kwargs)
     raise ValueError(
         f"unknown dataset {name!r} (expected one of {NAMED_DATASETS})"
     )

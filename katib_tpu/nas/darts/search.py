@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from katib_tpu.models.data import Dataset, batches, load_cifar10
+from katib_tpu.models.data import Dataset, batches, load_named_dataset
 from katib_tpu.nas.darts.architect import (
     DartsHyper,
     SearchState,
@@ -307,8 +307,6 @@ def darts_trial(ctx) -> None:
     num_layers = int(ctx.params.get("num-layers", 8))
 
     # same dataset knob as the ENAS trial (models/data.py dispatch)
-    from katib_tpu.models.data import load_named_dataset
-
     n_train = settings.get("n_train")
     n_test = settings.get("n_test")
     dataset = load_named_dataset(

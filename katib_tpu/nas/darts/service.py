@@ -42,7 +42,10 @@ DEFAULT_SETTINGS: dict[str, object] = {
     "stem_multiplier": 3,
 }
 
-_POSITIVE_INT = {"num_epochs", "batch_size", "init_channels", "num_nodes", "stem_multiplier"}
+_POSITIVE_INT = {
+    "num_epochs", "batch_size", "init_channels", "num_nodes",
+    "stem_multiplier", "n_train", "n_test",
+}
 # augment_epochs may be 0 (off, the default); validated separately below
 _NON_NEGATIVE_INT = {"augment_epochs"}
 _POSITIVE_FLOAT = {

@@ -180,3 +180,64 @@ class TestPathSafetyProperties:
         base = os.path.abspath("/w/dir")
         joined = os.path.abspath(os.path.join(base, name))
         assert joined.startswith(base + os.sep) and joined != base
+
+
+class TestAshaProperties:
+    @settings(max_examples=30, deadline=None)
+    @given(
+        st.lists(st.floats(0.0, 1.0), min_size=4, max_size=40),
+        st.integers(2, 4),
+        st.integers(1, 6),
+    )
+    def test_promotions_unique_and_monotone(self, scores, eta, batch):
+        """Under ANY completion order/scores: no parent is ever promoted
+        twice, promoted children keep the parent's config with a strictly
+        larger resource, and asks never block or duplicate rung-0 configs."""
+        from tests.helpers import complete_trial, make_spec
+
+        from katib_tpu.core.types import (
+            Experiment,
+            FeasibleSpace,
+            ObjectiveType,
+            ParameterSpec,
+            ParameterType,
+        )
+        from katib_tpu.suggest.base import make_suggester
+
+        spec = make_spec(
+            "asha",
+            settings={"r_max": "9", "eta": str(eta), "resource_name": "r"},
+            parameters=[
+                ParameterSpec("x", ParameterType.DOUBLE,
+                              FeasibleSpace(min=0.0, max=1.0)),
+                ParameterSpec("r", ParameterType.INT,
+                              FeasibleSpace(min=1, max=9)),
+            ],
+            objective_type=ObjectiveType.MAXIMIZE,
+        )
+        s = make_suggester(spec)
+        exp = Experiment(spec=spec)
+
+        parents_seen: set[str] = set()
+        fresh_configs: list[float] = []
+        queue = list(scores)
+        while queue:
+            proposals = s.get_suggestions(exp, batch)
+            assert len(proposals) == batch  # asha never blocks
+            for p in proposals:
+                d = p.as_dict()
+                parent = p.labels.get("asha-parent")
+                if parent is not None:
+                    assert parent not in parents_seen, "parent promoted twice"
+                    parents_seen.add(parent)
+                    pt = exp.trials[parent]
+                    # config preserved, resource strictly raised
+                    assert d["x"] == pt.params()["x"]
+                    assert int(float(d["r"])) > int(float(pt.params()["r"]))
+                else:
+                    fresh_configs.append(d["x"])
+                if not queue:
+                    break
+                complete_trial(exp, p, queue.pop(0))
+        # fresh rung-0 configs never repeat (deterministic per-index stream)
+        assert len(fresh_configs) == len(set(fresh_configs))
