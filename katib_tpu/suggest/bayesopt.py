@@ -26,7 +26,6 @@ Settings (mirroring the reference's accepted skopt settings,
 from __future__ import annotations
 
 import numpy as np
-from scipy.stats import norm
 
 from katib_tpu.core.types import Experiment, ExperimentSpec, TrialAssignmentSet
 from katib_tpu.suggest.base import Suggester, SuggesterError, register
@@ -42,6 +41,15 @@ _DEFAULT_ACQ = "gp_hedge"
 class BayesOptSuggester(Suggester):
     @classmethod
     def validate(cls, spec: ExperimentSpec) -> None:
+        import importlib.util
+
+        # sklearn/scipy imports are deferred for startup speed; presence
+        # still fails at submission, not mid-run
+        for dep in ("scipy", "sklearn"):
+            if importlib.util.find_spec(dep) is None:
+                raise SuggesterError(
+                    f"bayesianoptimization requires {dep} (the 'bayesopt' extra)"
+                )
         s = spec.algorithm.settings
         if s.get("base_estimator", "GP") != "GP":
             raise SuggesterError("only base_estimator=GP is supported")
@@ -80,6 +88,11 @@ class BayesOptSuggester(Suggester):
     ) -> np.ndarray:
         """Acquisition scores from a shared GP posterior (one ``predict``
         serves every acquisition — gp_hedge needs all three per ask)."""
+        # scipy.stats costs ~2s of import time; every orchestrator start
+        # imports this module via the algorithm registry, so the import
+        # stays inside the only function that needs it
+        from scipy.stats import norm
+
         sigma = np.maximum(sigma, 1e-9)
         if acq == "lcb":
             return -(mu - 1.96 * sigma)  # maximize negative lower bound

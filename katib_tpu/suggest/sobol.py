@@ -5,8 +5,6 @@ stream continues correctly across restarts."""
 
 from __future__ import annotations
 
-from scipy.stats import qmc
-
 from katib_tpu.core.types import Experiment, TrialAssignmentSet
 from katib_tpu.suggest.base import Suggester, register
 from katib_tpu.suggest.space import SpaceEncoder
@@ -14,9 +12,24 @@ from katib_tpu.suggest.space import SpaceEncoder
 
 @register("sobol")
 class SobolSuggester(Suggester):
+    @classmethod
+    def validate(cls, spec) -> None:
+        # the scipy import itself is deferred to first use for startup
+        # speed; presence still fails at submission, not mid-run
+        import importlib.util
+
+        if importlib.util.find_spec("scipy") is None:
+            from katib_tpu.suggest.base import SuggesterError
+
+            raise SuggesterError("sobol requires scipy (pip install scipy)")
+
     def get_suggestions(
         self, experiment: Experiment, count: int
     ) -> list[TrialAssignmentSet]:
+        # scipy.stats costs ~2s of import; the registry imports this module
+        # on every orchestrator start, so defer to first use
+        from scipy.stats import qmc
+
         space = SpaceEncoder(self.spec.parameters)
         sampler = qmc.Sobol(d=space.n_dims, scramble=True, seed=self.seed())
         cursor = len(experiment.trials)
