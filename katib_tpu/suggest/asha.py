@@ -28,7 +28,11 @@ Design notes, mirroring ``hyperband.py``'s conventions:
 
 Settings: ``resource_name`` (required, a declared parameter),
 ``r_max`` (required), ``r_min`` (default 1), ``eta`` (default 3),
-``devices_per_rung`` (default off).
+``devices_per_rung`` (default off), ``sampler`` (``random`` default, or
+``tpe`` for BOHB-style model-based sampling: fresh rung-0 configurations
+come from a TPE fitted on ALL completed trials instead of the uniform
+prior — Falkner et al. 2018's combination of Bayesian optimization with
+successive halving, which neither katib nor its hyperband service has).
 """
 
 from __future__ import annotations
@@ -78,6 +82,18 @@ class AshaSuggester(Suggester):
             raise SuggesterError(
                 f"resource_name {s['resource_name']!r} must be a declared parameter"
             )
+        sampler = s.get("sampler", "random")
+        if sampler not in ("random", "tpe"):
+            raise SuggesterError(
+                f"sampler must be 'random' or 'tpe', got {sampler!r}"
+            )
+        if sampler == "tpe":
+            import importlib.util
+
+            # TPE's model phase needs scipy; presence must fail at
+            # submission, not after n_startup_trials completions
+            if importlib.util.find_spec("scipy") is None:
+                raise SuggesterError("sampler: tpe requires scipy")
 
     # -- config ------------------------------------------------------------
 
@@ -148,18 +164,56 @@ class AshaSuggester(Suggester):
         labels[PARENT_LABEL] = trial.name
         return TrialAssignmentSet(assignments=assignments, labels=labels)
 
-    def _fresh(
-        self, space: SpaceEncoder, resource_name: str, index: int
-    ) -> TrialAssignmentSet:
-        # one rng stream per rung-0 index: deterministic across restarts
-        # without replaying the whole history (ASHA's rung 0 is unbounded,
-        # so hyperband's burn-`skip`-samples pattern would be O(n^2) here)
-        params = space.sample(self.rng(extra=index))
+    def _fresh_batch(
+        self,
+        experiment: Experiment,
+        space: SpaceEncoder,
+        resource_name: str,
+        start_index: int,
+        n: int,
+    ) -> list[TrialAssignmentSet]:
+        """``n`` new rung-0 configurations."""
         r = self._resource(0)
-        params[resource_name] = self.spec.parameter(resource_name).cast(r)
-        return TrialAssignmentSet(
-            assignments=space.to_assignments(params), labels=self._labels(0, r)
-        )
+        if self.spec.algorithm.setting("sampler") == "tpe":
+            # BOHB-style model-based sampling (Falkner et al. 2018):
+            # configurations come from a TPE fitted on every completed
+            # trial, low-fidelity observations included.  ONE delegate call
+            # per batch — TPE's in-batch median-injection diversifies the n
+            # draws, where per-slot calls would return n identical configs
+            # (same rng seed, same history).  The delegate's space excludes
+            # the resource parameter: its value is a rung artifact, not a
+            # hyperparameter to model.  TPE is stateless-from-history, so
+            # restart determinism is preserved.
+            import dataclasses
+
+            from katib_tpu.suggest.tpe import TPESuggester
+
+            sub_spec = dataclasses.replace(
+                self.spec,
+                parameters=[
+                    p for p in self.spec.parameters if p.name != resource_name
+                ],
+            )
+            props = TPESuggester(sub_spec).get_suggestions(experiment, n)
+            param_dicts = [{a.name: a.value for a in p.assignments} for p in props]
+        else:
+            # one rng stream per rung-0 index: deterministic across
+            # restarts without replaying the whole history (ASHA's rung 0
+            # is unbounded, so hyperband's burn-`skip`-samples pattern
+            # would be O(n^2) here)
+            param_dicts = [
+                space.sample(self.rng(extra=start_index + i)) for i in range(n)
+            ]
+        out = []
+        for params in param_dicts:
+            params[resource_name] = self.spec.parameter(resource_name).cast(r)
+            out.append(
+                TrialAssignmentSet(
+                    assignments=space.to_assignments(params),
+                    labels=self._labels(0, r),
+                )
+            )
+        return out
 
     def get_suggestions(
         self, experiment: Experiment, count: int
@@ -175,13 +229,20 @@ class AshaSuggester(Suggester):
             for k in range(max_rung - 1, -1, -1)
             for t in self._promotable(experiment, k, eta)
         ]
-        out: list[TrialAssignmentSet] = []
-        n_rung0 = len(self._rung_trials(experiment, 0))
-        for slot in range(count):
-            if slot < len(frontier):
-                k, t = frontier[slot]
-                out.append(self._promote(t, k + 1, resource_name))
-            else:
-                out.append(self._fresh(space, resource_name, index=n_rung0))
-                n_rung0 += 1
+        n_promote = min(len(frontier), count)
+        out = [
+            self._promote(t, k + 1, resource_name)
+            for k, t in frontier[:n_promote]
+        ]
+        n_fresh = count - n_promote
+        if n_fresh:
+            out.extend(
+                self._fresh_batch(
+                    experiment,
+                    space,
+                    resource_name,
+                    start_index=len(self._rung_trials(experiment, 0)),
+                    n=n_fresh,
+                )
+            )
         return out

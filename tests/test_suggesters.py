@@ -420,6 +420,52 @@ class TestAsha:
         assert [p.as_dict() for p in got] == [p.as_dict() for p in expected]
         assert [p.labels for p in got] == [p.labels for p in expected]
 
+    def test_tpe_sampler_bohb_style(self):
+        """sampler: tpe — fresh rung-0 configs come from a TPE fitted on
+        completed trials (BOHB); promotions and restart determinism are
+        unchanged."""
+        spec = make_spec(
+            "asha",
+            settings={"r_max": "9", "eta": "3", "resource_name": "epochs",
+                      "sampler": "tpe", "n_startup_trials": "3"},
+            parameters=[
+                ParameterSpec("lr", ParameterType.DOUBLE,
+                              FeasibleSpace(min=0.001, max=0.1)),
+                ParameterSpec("epochs", ParameterType.INT,
+                              FeasibleSpace(min=1, max=9)),
+            ],
+            objective_type=ObjectiveType.MAXIMIZE,
+        )
+        s = make_suggester(spec)
+        exp = new_exp(spec)
+        for p in s.get_suggestions(exp, 3):
+            assert p.labels["asha-rung"] == "0"
+            assert p.as_dict()["epochs"] == 1  # rung resource still applies
+            complete_trial(exp, p, p.as_dict()["lr"])
+        batch = s.get_suggestions(exp, 3)
+        # one promotion (floor(3/3)) + model-based fresh configs
+        assert sum(1 for p in batch if p.labels.get("asha-parent")) == 1
+        # fresh configs within one batch must be DISTINCT (one delegate
+        # call diversifies; per-slot calls would duplicate the same draw)
+        fresh_lrs = [p.as_dict()["lr"] for p in batch
+                     if not p.labels.get("asha-parent")]
+        assert len(fresh_lrs) == len(set(fresh_lrs)) == 2
+        # the resource value is a rung artifact, never a modeled dim
+        assert all(p.as_dict()["epochs"] == 1 for p in batch
+                   if not p.labels.get("asha-parent"))
+        # restart determinism: a fresh suggester proposes identically
+        s2 = make_suggester(spec)
+        again = s2.get_suggestions(exp, 3)
+        assert [p.as_dict() for p in again] == [p.as_dict() for p in batch]
+        # bad sampler rejected at submission
+        with pytest.raises(SuggesterError, match="sampler"):
+            make_suggester(make_spec(
+                "asha",
+                settings={"r_max": "9", "resource_name": "epochs",
+                          "sampler": "cmaes"},
+                parameters=spec.parameters,
+            ))
+
     def test_failed_trials_never_promote_or_deadlock(self):
         spec = self._spec(r_max=9.0, eta=3)
         s = make_suggester(spec)
