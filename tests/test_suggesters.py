@@ -441,7 +441,10 @@ class TestAsha:
         for p in s.get_suggestions(exp, 3):
             assert p.labels["asha-rung"] == "0"
             assert p.as_dict()["epochs"] == 1  # rung resource still applies
-            complete_trial(exp, p, p.as_dict()["lr"])
+            # interior optimum: a boundary optimum would make TPE clamp
+            # every model-phase draw to the same bound value, which is
+            # legitimate TPE behavior but defeats the distinctness check
+            complete_trial(exp, p, -((p.as_dict()["lr"] - 0.05) ** 2))
         batch = s.get_suggestions(exp, 3)
         # one promotion (floor(3/3)) + model-based fresh configs
         assert sum(1 for p in batch if p.labels.get("asha-parent")) == 1
