@@ -5,10 +5,12 @@ until every trial in the rung finishes, so one slow trial idles every
 other slot (the reference inherits this, ``hyperband/service.py:127``).
 ASHA promotes asynchronously — the exact failure mode this demo measures.
 
-Both algorithms tune the same toy objective with the same parallelism and
-a per-trial duration proportional to its resource (epochs) plus jitter
-(the straggler). The artifact records, for each: wall-clock to complete
-the budget, best objective, and best-objective-vs-wallclock curve.
+Three arms tune the same toy objective with the same parallelism and a
+per-trial duration proportional to its resource (epochs) plus jitter (the
+straggler): uniform ASHA, BOHB-style ASHA (``sampler: tpe`` — needs
+scipy; the arm is skipped on a base install), and Hyperband.  The
+artifact records, for each: wall-clock to complete the budget, best
+objective, and best-objective-vs-wallclock curve.
 
 Run: python scripts/run_asha_demo.py   (CPU)
 Artifact: artifacts/asha/comparison.json
@@ -108,12 +110,25 @@ def main() -> int:
     # charged to whichever algorithm happens to run first
     run_one("random", {}, 2, 2)
 
-    asha = run_one(
-        "asha",
-        {"r_max": "9", "r_min": "1", "eta": "3", "resource_name": "epochs"},
-        trials, parallel,
-    )
+    asha_settings = {"r_max": "9", "r_min": "1", "eta": "3",
+                     "resource_name": "epochs"}
+    asha = run_one("asha", asha_settings, trials, parallel)
     print(json.dumps(asha), flush=True)
+    # BOHB-style arm: SAME schedule, fresh configs from a TPE fitted on
+    # the history instead of the uniform prior; scipy is an optional
+    # dependency, so a base install skips the arm rather than dying after
+    # the uniform arm already ran
+    import importlib.util
+
+    asha_tpe = None
+    if importlib.util.find_spec("scipy") is not None:
+        asha_tpe = run_one(
+            "asha", {**asha_settings, "sampler": "tpe"}, trials, parallel
+        )
+        print(json.dumps(asha_tpe), flush=True)
+    else:
+        print("scipy not installed; skipping the sampler:tpe arm",
+              file=sys.stderr)
     hyperband = run_one(
         "hyperband",
         {"r_l": "9", "eta": "3", "resource_name": "epochs"},
@@ -138,9 +153,14 @@ def main() -> int:
             "stragglers, asha doesn't"
         ),
         "asha": asha,
+        "asha_tpe_sampler": asha_tpe,
         "hyperband": hyperband,
         "time_to_085": {
             "asha": time_to(asha["best_vs_wallclock"], threshold),
+            "asha_tpe_sampler": (
+                time_to(asha_tpe["best_vs_wallclock"], threshold)
+                if asha_tpe else None
+            ),
             "hyperband": time_to(hyperband["best_vs_wallclock"], threshold),
         },
     }
