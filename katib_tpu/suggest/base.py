@@ -95,14 +95,21 @@ class Suggester(abc.ABC):
 
     def seed(self, extra: int = 0) -> int:
         """Deterministic per-experiment seed.  ``random_state`` setting wins;
-        otherwise hash the experiment name so reruns are reproducible."""
+        otherwise the experiment name seeds it, so reruns are reproducible.
+
+        ``extra`` selects an independent stream: it is HASH-MIXED with the
+        base, never added — additive composition makes adjacent seeds
+        produce overlapping generator families (seed 2's stream at index n
+        equals seed 1's at n+1), which silently correlates what should be
+        independent replicates (e.g. a multi-seed benchmark's random
+        baseline collapsing to one sample)."""
         s = self.spec.algorithm.setting("random_state") or self.spec.algorithm.setting(
             "seed"
         )
-        if s is not None:
-            return int(s) + extra
-        digest = hashlib.sha256(self.spec.name.encode()).digest()
-        return int.from_bytes(digest[:4], "little") + extra
+        base = str(int(s)) if s is not None else self.spec.name
+        digest = hashlib.sha256(f"{base}:{extra}".encode()).digest()
+        # 4 bytes: sklearn's random_state requires [0, 2^32)
+        return int.from_bytes(digest[:4], "little")
 
     def rng(self, extra: int = 0) -> np.random.Generator:
         return np.random.default_rng(self.seed(extra))
