@@ -92,9 +92,13 @@ def main() -> int:
         ctx.params.setdefault("batch_size", "64")
         enas_trial(ctx)
 
+    # ENAS_NAME_SUFFIX varies the experiment name and therefore every
+    # derived seed stream — the knob multi-seed A/B studies use
+    suffix = os.environ.get("ENAS_NAME_SUFFIX", "")
+    base_name = ("enas-digits-shared" if share else "enas-digits") \
+        if dataset == "digits" else "enas-demo"
     spec = ExperimentSpec(
-        name=("enas-digits-shared" if share else "enas-digits")
-        if dataset == "digits" else "enas-demo",
+        name=base_name + suffix,
         objective=ObjectiveSpec(
             type=ObjectiveType.MAXIMIZE, objective_metric_name="accuracy"
         ),
@@ -103,6 +107,10 @@ def main() -> int:
             settings={
                 "controller_hidden_size": "32",
                 "controller_train_steps": "10",
+                # ENAS_SEED pins the controller's stream independently of
+                # the experiment name, so A/B arms can be seed-PAIRED
+                **({"random_state": os.environ["ENAS_SEED"]}
+                   if os.environ.get("ENAS_SEED") else {}),
             },
         ),
         nas_config=NasConfig(
@@ -189,10 +197,11 @@ def main() -> int:
         "controller_reward_per_round": reward_curve,
     }
     summary["weight_sharing"] = share
-    name = "demo_summary.json"
-    if dataset == "digits":
-        name = "digits_shared_summary.json" if share else "digits_summary.json"
-    write_artifact("enas", name, summary)
+    if not suffix:  # A/B sweep runs must not clobber the canonical artifacts
+        name = "demo_summary.json"
+        if dataset == "digits":
+            name = "digits_shared_summary.json" if share else "digits_summary.json"
+        write_artifact("enas", name, summary)
     print(json.dumps({k: summary[k] for k in (
         "condition", "trials_total", "wallclock_s", "best_objective",
     )} | {"reward_curve": reward_curve}), flush=True)
