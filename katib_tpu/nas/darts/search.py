@@ -190,6 +190,9 @@ def run_darts_search(
                         NativeBatchLoader(
                             xs_, ys_, batch=batch_size, seed=sd,
                             cache_path=os.path.join(loader_cache_dir, name),
+                            # resumed runs consume epoch k's shuffle, same
+                            # invariant as the Python batches() path below
+                            start_epoch=start_epoch,
                         )
                     )
                 native_loaders = tuple(built)
@@ -220,8 +223,13 @@ def run_darts_search(
                 w_stream = native_loaders[0].epoch()
                 a_stream = native_loaders[1].epoch()
             else:
-                w_stream = batches(x_w, y_w, batch_size, rng)
-                a_stream = batches(x_a, y_a, batch_size, rng)
+                # per-epoch stream keyed on (seed, epoch): a run resumed at
+                # epoch k shuffles exactly like the uninterrupted run would
+                # have — a shared sequential rng would replay epoch 0's
+                # order after every restart
+                erng = np.random.default_rng([seed, epoch])
+                w_stream = batches(x_w, y_w, batch_size, erng)
+                a_stream = batches(x_a, y_a, batch_size, erng)
             # keep per-step losses as device futures: float()-ing inside the
             # loop would block the host on every step and serialize the
             # async dispatch pipeline (one device round-trip per step — on a

@@ -24,7 +24,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.ktl_open.restype = ctypes.c_void_p
     lib.ktl_open.argtypes = [
         ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
-        ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint32,
+        ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint64,
     ]
     lib.ktl_next.restype = ctypes.c_int64
     lib.ktl_next.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
@@ -81,6 +81,7 @@ class NativeBatchLoader:
         cache_path: str,
         n_threads: int = 2,
         queue_cap: int = 8,
+        start_epoch: int = 0,
     ):
         if not ensure_built():
             raise RuntimeError("native runtime unavailable (no C++ toolchain)")
@@ -96,8 +97,12 @@ class NativeBatchLoader:
         self.batch = batch
         record_bytes, n = pack_dataset(x, y, cache_path)
         assert record_bytes == self._x_bytes + self._y_bytes
+        # start_epoch: a resumed run opens at its restored epoch so the
+        # first .epoch() yields that epoch's (seed, epoch)-keyed shuffle,
+        # not a positional replay of epoch 0
         self._h = self._lib.ktl_open(
-            cache_path.encode(), record_bytes, n, batch, seed, n_threads, queue_cap
+            cache_path.encode(), record_bytes, n, batch, seed, n_threads,
+            queue_cap, start_epoch,
         )
         if not self._h:
             raise RuntimeError(f"ktl_open failed for {cache_path}")
@@ -106,6 +111,11 @@ class NativeBatchLoader:
     @property
     def batches_per_epoch(self) -> int:
         return int(self._lib.ktl_batches_per_epoch(self._h))
+
+    @property
+    def epoch_index(self) -> int:
+        """Epoch of the next batch to be delivered."""
+        return int(self._lib.ktl_epoch(self._h))
 
     def epoch(self):
         """Yield this epoch's (x, y) batches (drop-last semantics)."""

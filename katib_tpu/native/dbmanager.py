@@ -148,14 +148,24 @@ class DbManagerHandle:
             self.proc.wait()
 
 
-def spawn_db_manager(host: str = "127.0.0.1", port: int = 0) -> DbManagerHandle:
-    """Launch the daemon (port 0 = ephemeral); blocks until it listens."""
+def spawn_db_manager(
+    host: str = "127.0.0.1", port: int = 0, db_path: str | None = None
+) -> DbManagerHandle:
+    """Launch the daemon (port 0 = ephemeral); blocks until it listens.
+
+    ``db_path`` enables the append-only frame journal: acked mutations
+    survive a daemon crash and are replayed on the next start (parity with
+    the reference daemon's persisted SQL table, ``mysql/init.go:35``).
+    """
     if not ensure_built():
         from katib_tpu.native.build import build_error
 
         raise RuntimeError(f"native build failed: {build_error()}")
+    cmd = [DBMANAGER_PATH, "--host", host, "--port", str(port)]
+    if db_path is not None:
+        cmd += ["--db", db_path]
     proc = subprocess.Popen(
-        [DBMANAGER_PATH, "--host", host, "--port", str(port)],
+        cmd,
         stdout=subprocess.PIPE,
         text=True,
     )

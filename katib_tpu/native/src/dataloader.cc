@@ -6,7 +6,8 @@
 // Python to gather a shuffled batch.
 //
 // C API (ctypes-friendly, see native/dataloader.py):
-//   ktl_open(path, record_bytes, n_records, batch, seed, threads, queue_cap)
+//   ktl_open(path, record_bytes, n_records, batch, seed, threads, queue_cap,
+//            start_epoch)
 //   ktl_next(h, out)  -> records copied (always == batch; -1 on error).
 //                        The stream is epoch-continuous: consume exactly
 //                        ktl_batches_per_epoch(h) batches per epoch.
@@ -133,7 +134,7 @@ extern "C" {
 
 void* ktl_open(const char* path, uint64_t record_bytes, uint64_t n_records,
                uint64_t batch, uint64_t seed, uint32_t n_threads,
-               uint32_t queue_cap) {
+               uint32_t queue_cap, uint64_t start_epoch) {
   if (record_bytes == 0 || n_records == 0 || batch == 0 || batch > n_records)
     return nullptr;
   int fd = open(path, O_RDONLY);
@@ -156,6 +157,11 @@ void* ktl_open(const char* path, uint64_t record_bytes, uint64_t n_records,
   L->batch = batch;
   L->seed = seed;
   L->batches_per_epoch = n_records / batch;  // drop-last semantics
+  // Resume support: start the global batch sequence at `start_epoch` so a
+  // restarted run consumes epoch k's permutation (seeded (seed, k)), not a
+  // positional replay of epoch 0.  Set before workers spawn — no racing
+  // producers exist yet, so no slot-reclaim protocol is needed.
+  L->next_produce = L->next_consume = start_epoch * L->batches_per_epoch;
   if (n_threads == 0) n_threads = 2;
   if (queue_cap < n_threads) queue_cap = n_threads * 2;
   L->queue_cap = queue_cap;

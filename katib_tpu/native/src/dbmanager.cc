@@ -20,6 +20,17 @@
 //
 // Thread-per-connection over one mutex-guarded store; connections are
 // long-lived (the Python client keeps one socket open per process).
+//
+// Durability (--db <path>): the reference's daemon fronts a persisted SQL
+// table (pkg/db/v1beta1/mysql/mysql.go:67, schema mysql/init.go:35) — a
+// crash loses nothing.  This daemon gets the same guarantee with an
+// append-only frame journal: a mutation (REPORT/DELETE) first appends its
+// raw request frame to the journal and flushes, then applies to the store
+// — durable-before-applied-before-acked; startup replays the journal
+// through the same request handler before listening.
+// One serialization format for wire and disk, zero translation code.  A
+// truncated tail frame (crash mid-append) is detected and trimmed on
+// replay.  Without --db the daemon is the round-2 in-RAM service.
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
@@ -31,6 +42,7 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <mutex>
 #include <string>
 #include <thread>
 #include <vector>
@@ -40,6 +52,10 @@
 namespace {
 
 kt_store_t g_store;
+std::FILE* g_journal = nullptr;  // append handle; null = in-RAM mode
+std::string g_journal_path;
+bool g_journal_broken = false;  // unrecoverable append failure: reject writes
+std::mutex g_journal_mu;
 
 bool read_exact(int fd, void* buf, size_t n) {
   char* p = static_cast<char*>(buf);
@@ -180,6 +196,72 @@ void handle_request(const std::vector<uint8_t>& req, Writer* out) {
   out->put<uint8_t>(1);
 }
 
+// Appends one frame; caller holds g_journal_mu.  Returns false when the
+// append could not be made durable — the caller must NOT ack the request
+// (acked == journaled is the whole guarantee).  A short write (ENOSPC,
+// I/O error) is rolled back by truncating to the pre-write offset so it
+// can't become a corrupt tail that replay would trim LATER good frames
+// behind; if even the rollback fails the journal is marked broken and all
+// further mutations are rejected while reads keep serving.
+bool append_journal_locked(const std::vector<uint8_t>& frame) {
+  if (g_journal_broken) return false;
+  long start = std::ftell(g_journal);
+  uint32_t len = static_cast<uint32_t>(frame.size());
+  bool ok = std::fwrite(&len, sizeof(len), 1, g_journal) == 1 &&
+            std::fwrite(frame.data(), 1, frame.size(), g_journal) ==
+                frame.size() &&
+            // flush to the OS so a killed daemon loses nothing (page cache
+            // survives process death; only power loss needs fdatasync)
+            std::fflush(g_journal) == 0;
+  if (ok) return true;
+  std::fprintf(stderr, "journal: append failed, rolling back\n");
+  if (start < 0 || std::fflush(g_journal) != 0 ||
+      ::truncate(g_journal_path.c_str(), start) != 0 ||
+      std::fseek(g_journal, start, SEEK_SET) != 0) {
+    std::fprintf(stderr, "journal: rollback failed — rejecting writes\n");
+    g_journal_broken = true;
+  }
+  return false;
+}
+
+// Replays mutation frames from the journal into the fresh store.  Returns
+// the byte offset of the last complete frame; a truncated tail (crash
+// mid-append) is trimmed so subsequent appends can't corrupt the file.
+void replay_journal(const char* path) {
+  std::FILE* f = std::fopen(path, "rb");
+  long valid_end = 0;
+  long replayed = 0;
+  if (f) {
+    for (;;) {
+      uint32_t len;
+      if (std::fread(&len, sizeof(len), 1, f) != 1) break;
+      if (len == 0 || len > (64u << 20)) break;  // corrupt header
+      std::vector<uint8_t> req(len);
+      if (std::fread(req.data(), 1, len, f) != len) break;
+      Writer ignored;
+      handle_request(req, &ignored);
+      valid_end = std::ftell(f);
+      ++replayed;
+    }
+    long file_end = 0;
+    if (std::fseek(f, 0, SEEK_END) == 0) file_end = std::ftell(f);
+    std::fclose(f);
+    if (file_end != valid_end) {
+      std::fprintf(stderr, "journal: trimming truncated tail (%ld -> %ld)\n",
+                   file_end, valid_end);
+      if (::truncate(path, valid_end) != 0) std::perror("truncate");
+    }
+  }
+  g_journal_path = path;
+  g_journal = std::fopen(path, "ab");
+  if (!g_journal) {
+    std::perror("journal open");
+    std::exit(1);
+  }
+  std::printf("JOURNAL %ld frames, %lld points\n", replayed,
+              static_cast<long long>(kt_store_total(g_store)));
+}
+
 void serve_connection(int fd) {
   int one = 1;
   ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
@@ -190,7 +272,25 @@ void serve_connection(int fd) {
     std::vector<uint8_t> req(len);
     if (!read_exact(fd, req.data(), len)) break;
     Writer out;
-    handle_request(req, &out);
+    bool is_mutation = !req.empty() && (req[0] == 1 || req[0] == 3);
+    if (g_journal && is_mutation) {
+      // Journal-append and store-apply form ONE critical section, in that
+      // order.  One lock: with concurrent connections, separate locks
+      // could journal B's DELETE before A's REPORT while the store applied
+      // them the other way — replay would then resurrect deleted points.
+      // Journal FIRST: if the append fails nothing was applied, so the
+      // live store never diverges from what a restart would rebuild (a
+      // malformed frame that journals then no-ops replays as the same
+      // no-op).  Reads bypass this lock (the store has its own mutex).
+      std::lock_guard<std::mutex> lock(g_journal_mu);
+      if (append_journal_locked(req)) {
+        handle_request(req, &out);
+      } else {
+        out.put<uint8_t>(1);  // not durable -> not applied -> not acked
+      }
+    } else {
+      handle_request(req, &out);
+    }
     uint32_t olen = static_cast<uint32_t>(out.buf.size());
     if (!write_exact(fd, &olen, sizeof(olen)) ||
         !write_exact(fd, out.buf.data(), olen))
@@ -203,13 +303,16 @@ void serve_connection(int fd) {
 
 int main(int argc, char** argv) {
   const char* host = "127.0.0.1";
+  const char* db_path = nullptr;
   int port = 0;
   for (int i = 1; i < argc - 1; ++i) {
     if (!std::strcmp(argv[i], "--port")) port = std::atoi(argv[i + 1]);
     if (!std::strcmp(argv[i], "--host")) host = argv[i + 1];
+    if (!std::strcmp(argv[i], "--db")) db_path = argv[i + 1];
   }
   ::signal(SIGPIPE, SIG_IGN);
   g_store = kt_store_new();
+  if (db_path) replay_journal(db_path);
 
   int lfd = ::socket(AF_INET, SOCK_STREAM, 0);
   if (lfd < 0) {

@@ -82,6 +82,9 @@ class AshaSuggester(Suggester):
             raise SuggesterError(
                 f"resource_name {s['resource_name']!r} must be a declared parameter"
             )
+        cls.check_resource_in_space(
+            spec, s["resource_name"], r_min, r_max, what="r_min/r_max"
+        )
         sampler = s.get("sampler", "random")
         if sampler not in ("random", "tpe"):
             raise SuggesterError(

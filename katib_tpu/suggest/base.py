@@ -140,13 +140,32 @@ class Suggester(abc.ABC):
         lease (honored by the orchestrator's ElasticSliceAllocator), so
         promoted survivors get more chips, not just more epochs.  One copy
         of the setting parse for every rung-based suggester."""
-        if str(self.spec.algorithm.setting("devices_per_rung") or "").lower() in (
-            "1", "true", "yes",
-        ):
+        from katib_tpu.utils.booleans import parse_bool
+
+        if parse_bool(self.spec.algorithm.setting("devices_per_rung")):
             from katib_tpu.core.types import DEVICES_LABEL
 
             return {DEVICES_LABEL: str(r)}
         return {}
+
+    @staticmethod
+    def check_resource_in_space(
+        spec, resource_name: str, lo: float, hi: float, *, what: str = "resource bounds"
+    ) -> None:
+        """Raise unless ``[lo, hi]`` lies inside the declared feasible range
+        of the resource parameter.  ``ParameterSpec.cast`` rounds but does
+        not clamp, so rung resources outside the range would emit trial
+        assignments outside the declared search space.  Shared by the
+        successive-halving family (hyperband, asha)."""
+        p = next((p for p in spec.parameters if p.name == resource_name), None)
+        if p is None or p.feasible.min is None or p.feasible.max is None:
+            return  # presence / type of the parameter is checked separately
+        if lo < p.feasible.min or hi > p.feasible.max:
+            raise SuggesterError(
+                f"{what} [{lo:g}, {hi:g}] fall outside parameter "
+                f"{resource_name!r}'s feasible range "
+                f"[{p.feasible.min:g}, {p.feasible.max:g}]"
+            )
 
     @staticmethod
     def observed_xy(

@@ -82,6 +82,15 @@ class HyperbandSuggester(Suggester):
             raise SuggesterError(
                 f"parallel_trial_count must be >= {max_parallel} for r_l={r_l}, eta={eta}"
             )
+        # smallest rung resource is r_l * eta^(-s_max) (deepest bracket's
+        # first rung, _resource with i=0, s=s_max), floored at 1
+        cls.check_resource_in_space(
+            spec,
+            s["resource_name"],
+            cls._resource(r_l, eta, s_max, 0),
+            r_l,
+            what="rung resources",
+        )
 
     # -- parameters --------------------------------------------------------
 

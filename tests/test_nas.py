@@ -192,6 +192,31 @@ class TestDartsModel:
         assert elapsed == sorted(elapsed)
         assert second["best_accuracy"] >= first["best_accuracy"]
 
+    def test_resumed_shuffle_matches_uninterrupted_run(self, tmp_path):
+        """Batch order is keyed on (seed, epoch), not on a sequential rng:
+        epoch 1 of a run resumed from the epoch-0 checkpoint consumes the
+        same batches — and hence produces the same metrics — as epoch 1 of
+        an uninterrupted run.  (A shared rng would replay epoch 0's order
+        after the restart.)"""
+        from katib_tpu.models.data import synthetic_classification
+        from katib_tpu.nas.darts import DartsHyper, run_darts_search
+
+        ds = synthetic_classification(64, 32, (8, 8, 3), 4, seed=1, noise=0.3)
+        kw = dict(
+            primitives=TINY_PRIMS, num_layers=2, init_channels=4, n_nodes=2,
+            batch_size=16, hyper=DartsHyper(unrolled=False), seed=0,
+        )
+        straight = run_darts_search(
+            ds, num_epochs=2, checkpoint_dir=str(tmp_path / "a"), **kw
+        )
+        run_darts_search(ds, num_epochs=1, checkpoint_dir=str(tmp_path / "b"), **kw)
+        resumed = run_darts_search(
+            ds, num_epochs=2, checkpoint_dir=str(tmp_path / "b"), **kw
+        )
+        s1, r1 = straight["history"][1], resumed["history"][1]
+        assert r1["train_loss"] == pytest.approx(s1["train_loss"], rel=1e-6)
+        assert r1["val_accuracy"] == pytest.approx(s1["val_accuracy"], rel=1e-6)
+
 
 class TestDartsService:
     def test_single_trial_contract(self):

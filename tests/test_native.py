@@ -167,6 +167,83 @@ class TestDbManagerDaemon:
         finally:
             handle.stop()
 
+    def test_journal_survives_kill_dash_nine(self, tmp_path):
+        """--db makes acked mutations durable: kill -9 the daemon
+        mid-experiment, restart on the same journal, observations (and a
+        delete) survive — parity with the reference daemon's persisted SQL
+        table (mysql/init.go:35)."""
+        from katib_tpu.native import spawn_db_manager
+
+        db = str(tmp_path / "obs.journal")
+        handle = spawn_db_manager(db_path=db)
+        try:
+            client = handle.client()
+            for i in range(5):
+                client.report_point("t1", "loss", 1.0 - 0.1 * i, step=i)
+            client.report_point("doomed", "loss", 9.9)
+            client.delete("doomed")
+            client.close()
+        finally:
+            handle.proc.kill()  # SIGKILL: no shutdown path may run
+            handle.proc.wait()
+
+        handle2 = spawn_db_manager(db_path=db)
+        try:
+            client = handle2.client()
+            survived = client.get("t1", "loss")
+            assert [(l.value, l.step) for l in survived] == [
+                (pytest.approx(1.0 - 0.1 * i), i) for i in range(5)
+            ]
+            assert client.get("doomed") == []  # tombstone replayed too
+            # the journal keeps extending across restarts
+            client.report_point("t1", "loss", 0.42, step=5)
+            client.close()
+        finally:
+            handle2.proc.kill()
+            handle2.proc.wait()
+
+        handle3 = spawn_db_manager(db_path=db)
+        try:
+            client = handle3.client()
+            assert len(client.get("t1", "loss")) == 6
+            client.close()
+        finally:
+            handle3.stop()
+
+    def test_journal_trims_truncated_tail(self, tmp_path):
+        """A crash mid-append leaves a partial frame; replay must trim it
+        and keep accepting writes."""
+        from katib_tpu.native import spawn_db_manager
+
+        db = str(tmp_path / "obs.journal")
+        handle = spawn_db_manager(db_path=db)
+        try:
+            client = handle.client()
+            client.report_point("t", "m", 1.0, step=0)
+            client.close()
+        finally:
+            handle.proc.kill()
+            handle.proc.wait()
+        with open(db, "ab") as f:
+            f.write(b"\x40\x00\x00\x00partial")  # header promises 64B, has 7
+
+        handle2 = spawn_db_manager(db_path=db)
+        try:
+            client = handle2.client()
+            assert [l.value for l in client.get("t", "m")] == [1.0]
+            client.report_point("t", "m", 2.0, step=1)
+            client.close()
+        finally:
+            handle2.proc.kill()
+            handle2.proc.wait()
+        handle3 = spawn_db_manager(db_path=db)
+        try:
+            client = handle3.client()
+            assert [l.value for l in client.get("t", "m")] == [1.0, 2.0]
+            client.close()
+        finally:
+            handle3.stop()
+
     def test_blackbox_trial_reports_through_daemon(self, tmp_path):
         """A black-box subprocess trial with a RemoteObservationStore: the
         full cross-process metrics path (trial → stdout scrape → wire →
@@ -244,6 +321,29 @@ class TestNativeBatchLoader:
                     assert pairs[key] == int(yv)  # labels ride with images
                     seen.add(key)
         assert len(seen) == 48  # no duplicates within an epoch
+
+    def test_start_epoch_matches_sequential_consumption(self, tmp_path):
+        """A loader opened at start_epoch=k yields exactly what a fresh
+        loader yields for its (k+1)-th epoch — the resume invariant the
+        DARTS search relies on (a positional restart would silently replay
+        epoch 0's order after every preemption)."""
+        from katib_tpu.native import NativeBatchLoader
+
+        x, y = self._data()
+        p = str(tmp_path / "ds.bin")
+        with NativeBatchLoader(x, y, batch=8, seed=7, cache_path=p) as a:
+            for _ in a.epoch():
+                pass
+            for _ in a.epoch():
+                pass
+            third = [(xb.copy(), yb.copy()) for xb, yb in a.epoch()]
+        with NativeBatchLoader(x, y, batch=8, seed=7, cache_path=p,
+                               start_epoch=2) as b:
+            assert b.epoch_index == 2
+            resumed = [(xb.copy(), yb.copy()) for xb, yb in b.epoch()]
+        assert len(third) == len(resumed)
+        for (xa, ya), (xr, yr) in zip(third, resumed):
+            assert np.array_equal(xa, xr) and np.array_equal(ya, yr)
 
     def test_epochs_reshuffle_and_seeds_differ(self, tmp_path):
         from katib_tpu.native import NativeBatchLoader

@@ -367,6 +367,36 @@ class TestAsha:
             make_suggester(make_spec(
                 "asha", settings={"r_max": "9", "resource_name": "ghost"}))
 
+    def test_devices_per_rung_accepts_parse_bool_spellings(self):
+        """The setting goes through the shared parse_bool, so 'on' (truthy
+        on every other boolean surface) enables leasing here too instead of
+        being silently ignored by an ad-hoc whitelist."""
+        from katib_tpu.core.types import DEVICES_LABEL
+
+        spec = self._spec()
+        spec.algorithm.settings["devices_per_rung"] = "on"
+        s = make_suggester(spec)
+        exp = Experiment(spec=spec)
+        batch = s.get_suggestions(exp, 3)
+        assert all(p.labels.get(DEVICES_LABEL) == "1" for p in batch)
+
+    def test_resource_bounds_must_fit_feasible_range(self):
+        """cast() rounds but does not clamp — r_max beyond the declared
+        range would assign trials outside the search space, so submission
+        must reject it (same for an r_min below the range floor)."""
+        with pytest.raises(SuggesterError, match="feasible range"):
+            make_suggester(self._spec(r_max=50))  # epochs feasible is [1, 9]
+        floor = make_spec(
+            "asha",
+            settings={"r_max": "9", "r_min": "1", "resource_name": "epochs"},
+            parameters=[
+                ParameterSpec("epochs", ParameterType.INT,
+                              FeasibleSpace(min=2, max=9)),
+            ],
+        )
+        with pytest.raises(SuggesterError, match="feasible range"):
+            make_suggester(floor)
+
     def test_async_never_blocks_and_promotes_top(self):
         spec = self._spec(r_max=9.0, eta=3)  # rungs 0,1,2 at r=1,3,9
         s = make_suggester(spec)
@@ -524,6 +554,21 @@ class TestHyperband:
             make_suggester(bad)
         with pytest.raises(SuggesterError, match="r_l"):
             make_suggester(make_spec("hyperband", settings={"resource_name": "x"}))
+
+    def test_rung_resources_must_fit_feasible_range(self):
+        """r_l above the resource parameter's declared max would emit
+        assignments outside the search space (cast does not clamp)."""
+        spec = make_spec(
+            "hyperband",
+            settings={"r_l": "27", "eta": "3", "resource_name": "epochs"},
+            parameters=[
+                ParameterSpec("epochs", ParameterType.INT,
+                              FeasibleSpace(min=1, max=9)),
+            ],
+            parallel_trial_count=27,
+        )
+        with pytest.raises(SuggesterError, match="feasible range"):
+            make_suggester(spec)
 
     def test_bracket_progression(self):
         spec = self._spec(r_l=9.0, eta=3)  # s_max=2: brackets s=2,1,0
