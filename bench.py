@@ -159,6 +159,14 @@ def _aot_child() -> None:
     from jax.sharding import SingleDeviceSharding
 
     jax.config.update("jax_platforms", "cpu")  # host math only; TPU is a target
+    # persist the executable: the full-size TPU-target compile runs ~27 min
+    # on this host, so the driver's end-of-round bench must be a cache hit
+    cache_dir = os.path.join(_HERE, ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    except Exception:
+        pass
     t0 = time.perf_counter()
     topo = topologies.get_topology_desc(
         platform="tpu",
@@ -195,7 +203,6 @@ def _aot_child() -> None:
     peak = PEAK_FLOPS[("v5e", dtype_key)]
     compute_secs = flops / peak if flops else 0.0
     memory_secs = bytes_accessed / V5E_HBM_BW if bytes_accessed else 0.0
-    roofline_step = max(compute_secs, memory_secs)
     print(
         _RESULT_TAG
         + json.dumps(
@@ -208,14 +215,21 @@ def _aot_child() -> None:
                 "hbm_gib": round(hbm_bytes / 1024**3, 3),
                 "hbm_fits_v5e": hbm_bytes < V5E_HBM_BYTES,
                 "dtype": dtype_key,
-                "roofline_step_secs": round(roofline_step, 6),
-                "roofline_img_per_sec": (
-                    round(BATCH / roofline_step, 1) if roofline_step else None
-                ),
-                # achievable-MFU upper bound: compute time / roofline time
-                "roofline_mfu_bound": (
-                    round(compute_secs / roofline_step, 4) if roofline_step else None
-                ),
+                # step-time band, not a point estimate: the compute floor
+                # assumes MFU=1; the bandwidth figure charges XLA's
+                # PRE-FUSION "bytes accessed" (every op's operands+results)
+                # entirely to HBM, which overstates real traffic — the
+                # measured step lands between the two
+                "roofline": {
+                    "compute_floor_step_secs": round(compute_secs, 6),
+                    "compute_floor_img_per_sec": (
+                        round(BATCH / compute_secs, 1) if compute_secs else None
+                    ),
+                    "prefusion_bw_step_secs": round(memory_secs, 6),
+                    "prefusion_bw_img_per_sec": (
+                        round(BATCH / memory_secs, 1) if memory_secs else None
+                    ),
+                },
                 "compile_secs": round(compile_secs, 1),
                 "topology_secs": round(topo_secs, 1),
                 "config": {
@@ -444,18 +458,21 @@ def main() -> None:
     attempt_timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "3600"))
 
     # Pool-proof evidence first: AOT-compile the full-size program against
-    # a deviceless v5e topology.  Cheap (~1 min), never touches the relay,
-    # and pins flops/HBM/roofline even if every on-chip attempt fails.
-    # BENCH_SKIP_AOT=1 skips it (CPU smoke tests).
+    # a deviceless v5e topology.  Never touches the relay, and pins
+    # flops/HBM/roofline even if every on-chip attempt fails.  A warm
+    # persistent cache makes this ~1 min; a COLD full-shape compile runs
+    # ~27 min on this host (hence BENCH_AOT_TIMEOUT=2700 and the
+    # BENCH_SKIP_AOT=1 escape for smoke tests).
     aot_block = None
     if not parse_bool(os.environ.get("BENCH_SKIP_AOT")):
         aot_block = _run_aot()
         if aot_block is not None:
             print(
                 "bench: AOT v5e compile ok — "
-                f"hbm={aot_block['hbm_gib']} GiB, "
-                f"roofline {aot_block['roofline_img_per_sec']} img/s "
-                f"(mfu bound {aot_block['roofline_mfu_bound']})",
+                f"hbm={aot_block['hbm_gib']} GiB "
+                f"(fits={aot_block['hbm_fits_v5e']}), "
+                f"step band [{aot_block['roofline']['compute_floor_step_secs']}, "
+                f"{aot_block['roofline']['prefusion_bw_step_secs']}] s",
                 file=sys.stderr,
             )
 

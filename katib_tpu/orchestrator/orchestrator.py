@@ -64,6 +64,9 @@ class Orchestrator:
         slice_allocator=None,
     ):
         self.store = store if store is not None else MemoryObservationStore()
+        # a defaulted store may be upgraded to the durable sqlite backend at
+        # run() time for resumable experiments; an explicit store never is
+        self._store_defaulted = store is None
         self.workdir = workdir
         self.mesh = mesh
         # SliceAllocator (parallel/distributed.py): concurrent trials lease
@@ -144,6 +147,20 @@ class Orchestrator:
             from katib_tpu.orchestrator.resume import load_suggester_state
 
             load_suggester_state(suggester, self.workdir, exp.name)
+        # Lossless resume: resumable experiments upgrade a defaulted
+        # in-memory store to the durable sqlite backend, so early stopping
+        # reads TRUE per-trial series across restarts instead of
+        # _backfill_store's one-point approximation (the reference's
+        # observations live in the DB-manager's SQL table and survive
+        # controller restarts for free — ``mysql/init.go:35``).
+        if self._store_defaulted and spec.resume_policy is not ResumePolicy.NEVER:
+            from katib_tpu.store.sqlite import SqliteObservationStore
+
+            os.makedirs(self.workdir, exist_ok=True)
+            self.store = SqliteObservationStore(
+                os.path.join(self.workdir, "observations.sqlite")
+            )
+            self._store_defaulted = False  # keep it for later runs too
         if experiment is not None:
             self._backfill_store(exp)
         early_stopper = make_early_stopper(spec)

@@ -37,6 +37,10 @@ class SqliteObservationStore(ObservationStore):
         self._conn = sqlite3.connect(path, check_same_thread=False)
         self._lock = threading.RLock()
         with self._lock:
+            if path != ":memory:":
+                # WAL survives crashes without blocking readers on writers —
+                # the durability mode the resume path depends on
+                self._conn.execute("PRAGMA journal_mode=WAL")
             self._conn.executescript(_SCHEMA)
             self._conn.commit()
 

@@ -23,7 +23,15 @@ Endpoints (JSON unless noted):
 - ``POST /api/experiment/<name>/stop``         wind the running experiment down
 - ``DELETE /api/experiment/<name>``            remove a finished experiment's journal
                                                (``backend.go:138`` DeleteExperiment)
-- ``GET /``                                    dashboard (text/html, incl. create form)
+- ``GET /``                                    dashboard (text/html): experiment
+                                               table, create form, best-objective
+                                               sparkline, and per-trial drill-down —
+                                               click a trial row for its metric
+                                               chart (fed by ``/metrics``) and
+                                               rendered NAS cell/arc SVG (fed by
+                                               ``/nas?trial=``), the single-file
+                                               answer to the reference SPA's trial
+                                               detail + browser NAS views
 
 Write endpoints optionally require ``Authorization: Bearer <token>``
 (``token=`` / ``KATIB_UI_TOKEN``); reads stay open like the reference UI.
@@ -532,6 +540,82 @@ function sparkline(rows){
   return `<div><small>${last}</small><br>`+
     `<svg width="${W}" height="${H}"><polyline points="${pts}" fill="none" stroke="#2a7" stroke-width="2"/></svg></div>`;
 }
+const PALETTE=['#2a7','#15c','#e60','#a3c','#c22','#08a','#770'];
+function metricChart(rows){
+  // per-trial drill-down chart: one polyline per metric series from
+  // /api/trial/<name>/metrics (x = step, falling back to report order)
+  if(!rows||!rows.length)return '<small>no metric points</small>';
+  const series={};
+  rows.forEach(r=>{(series[r.metric_name]??=[]).push(r)});
+  const W=560,H=180,names=Object.keys(series);
+  const ally=rows.map(r=>r.value);
+  const y0=Math.min(...ally),y1=Math.max(...ally);
+  const py=v=>H-16-(H-32)*(v-y0)/((y1-y0)||1);
+  const lines=names.map((nm,i)=>{
+    const s=series[nm],useStep=s.every(r=>r.step>=0);
+    const xs=s.map((r,k)=>useStep?r.step:k);
+    const x0=Math.min(...xs),x1=Math.max(...xs);
+    const px=v=>40+(W-56)*(v-x0)/((x1-x0)||1);
+    const pts=s.map((r,k)=>px(xs[k])+','+py(r.value)).join(' ');
+    return s.length>1
+      ?`<polyline points="${pts}" fill="none" stroke="${PALETTE[i%PALETTE.length]}" stroke-width="1.6"/>`
+      :`<circle cx="${px(xs[0])}" cy="${py(s[0].value)}" r="3" fill="${PALETTE[i%PALETTE.length]}"/>`;
+  }).join('');
+  const legend=names.map((nm,i)=>
+    `<tspan x="46" dy="14" fill="${PALETTE[i%PALETTE.length]}">● ${esc(nm)}</tspan>`).join('');
+  return `<svg id="metricchart" width="${W}" height="${H}" style="background:#fff;box-shadow:0 1px 2px #0002">`+
+    `<text x="4" y="14" font-size="10">${esc(y1.toFixed?.(4)??y1)}</text>`+
+    `<text x="4" y="${H-6}" font-size="10">${esc(y0.toFixed?.(4)??y0)}</text>`+
+    lines+`<text font-size="11">${legend}</text></svg>`;
+}
+function nasGraph(g){
+  // rendered NAS cell/arc graph (the reference UI renders nas.go's graph
+  // in the browser); layered left→right by topological depth
+  if(!g||!g.nodes||!g.nodes.length)return '';
+  const depth={},incoming={};
+  g.nodes.forEach(n=>{incoming[n.id]=[]});
+  g.edges.forEach(e=>{(incoming[e.to]??=[]).push(e.from)});
+  const d=id=>{
+    if(depth[id]!=null)return depth[id];
+    depth[id]=0; // breaks accidental cycles
+    const ins=(incoming[id]||[]).map(d);
+    return depth[id]=ins.length?Math.max(...ins)+1:0;
+  };
+  g.nodes.forEach(n=>d(n.id));
+  const cols={};
+  g.nodes.forEach(n=>{(cols[depth[n.id]]??=[]).push(n.id)});
+  const pos={},CW=150,RH=52;
+  const H=40+RH*Math.max(...Object.values(cols).map(c=>c.length));
+  Object.entries(cols).forEach(([dep,ids])=>ids.forEach((id,k)=>{
+    pos[id]=[30+dep*CW,24+k*RH+((H-48-RH*(ids.length-1))/2)];
+  }));
+  const W=60+CW*Math.max(...Object.keys(cols).map(Number))+80;
+  const edges=g.edges.map(e=>{
+    const [x1,y1]=pos[e.from],[x2,y2]=pos[e.to];
+    const mx=(x1+x2)/2,my=(y1+y2)/2;
+    return `<line x1="${x1+46}" y1="${y1}" x2="${x2-46}" y2="${y2}" stroke="#888" marker-end="url(#arr)"/>`+
+      (e.op&&e.op!=='seq'?`<text x="${mx}" y="${my-4}" font-size="9" text-anchor="middle" fill="#555">${esc(e.op)}</text>`:'');
+  }).join('');
+  const nodes=g.nodes.map(n=>{
+    const [x,y]=pos[n.id];
+    return `<rect x="${x-46}" y="${y-13}" width="92" height="26" rx="6" fill="#eef4ff" stroke="#15c"/>`+
+      `<text x="${x}" y="${y+4}" font-size="10" text-anchor="middle">${esc(n.label||n.id)}</text>`;
+  }).join('');
+  return `<h2>architecture — ${esc(g.trial||'')} (${esc(g.type)})</h2>`+
+    `<svg id="nasgraph" width="${W}" height="${H}" style="background:#fff;box-shadow:0 1px 2px #0002">`+
+    `<defs><marker id="arr" markerWidth="7" markerHeight="7" refX="6" refY="3" orient="auto">`+
+    `<path d="M0,0 L7,3 L0,6 z" fill="#888"/></marker></defs>`+edges+nodes+`</svg>`;
+}
+let trialOf=null; // which experiment the drill-down panel belongs to
+async function showTrial(exp,trial){
+  trialOf=exp;
+  const [m,nas]=await Promise.all([
+    j('/api/trial/'+encodeURIComponent(trial)+'/metrics'),
+    j('/api/experiment/'+encodeURIComponent(exp)+'/nas?trial='+encodeURIComponent(trial))]);
+  document.getElementById('trialdetail').innerHTML=
+    `<h2>${esc(trial)} — metrics</h2>`+metricChart(Array.isArray(m)?m:[])+
+    (nas&&nas.nodes?nasGraph(nas):'');
+}
 async function show(name,re=true){
   current=name;
   const [st,t]=await Promise.all([
@@ -539,14 +623,20 @@ async function show(name,re=true){
     j('/api/experiment/'+encodeURIComponent(name)+'/trials')]);
   const cols=[...new Set(t.flatMap(r=>Object.keys(r.metrics||{})))];
   const pcols=[...new Set(t.flatMap(r=>Object.keys(r.assignments||{})))];
+  // keep the drill-down across the 3s redraw, but not across a switch to
+  // a different experiment (stale charts would masquerade as the new one's)
+  const keep=trialOf===name?(document.getElementById('trialdetail')?.innerHTML||''):'';
   document.getElementById('detail').innerHTML=
     sparkline(st.optimal_history)+
     `<h2>${esc(name)} — trials</h2><table><thead><tr><th>trial</th><th>status</th>`+
     pcols.map(p=>`<th>${esc(p)}</th>`).join('')+cols.map(c=>`<th>${esc(c)}</th>`).join('')+
-    `</tr></thead><tbody>`+t.map(r=>`<tr><td>${esc(r.name)}</td><td>${badge(r.condition)}</td>`+
+    `</tr></thead><tbody>`+t.map(r=>`<tr data-t="${esc(r.name)}"><td>${esc(r.name)}</td><td>${badge(r.condition)}</td>`+
       pcols.map(p=>`<td>${esc(r.assignments?.[p])}</td>`).join('')+
       cols.map(c=>{const v=r.metrics?.[c];return `<td>${v==null?"—":esc(v.toFixed?.(5)??v)}</td>`}).join('')+
-    `</tr>`).join('')+`</tbody></table>`;
+    `</tr>`).join('')+`</tbody></table><div id="trialdetail"></div>`;
+  document.getElementById('trialdetail').innerHTML=keep; // survive the 3s redraw
+  document.querySelectorAll('#detail tbody tr').forEach(tr=>
+    tr.onclick=()=>showTrial(name,tr.dataset.t));
   if(re)refresh();
 }
 refresh();setInterval(refresh,3000);

@@ -235,6 +235,65 @@ class TestKillAndResume:
         assert exp.succeeded_count == 5
 
 
+class TestLosslessResumeStore:
+    def test_resumed_medianstop_rules_equal_no_restart_rules(self, tmp_path):
+        """Resumable experiments auto-upgrade a defaulted store to durable
+        sqlite, so a restarted orchestrator's medianstop computes rules
+        over the TRUE multi-point series — identical to an uninterrupted
+        run — instead of _backfill_store's one-point approximation (which
+        would substitute each trial's reduced value for its early head and
+        shift the median)."""
+        from types import SimpleNamespace
+
+        from katib_tpu.core.types import EarlyStoppingSpec
+        from katib_tpu.earlystop.rules import make_early_stopper
+
+        def ramp_trainer(ctx):
+            # 5-point ramp: head average (start_step=3) != reduced max, so
+            # a one-point backfill would provably change the rule value
+            acc = 1.0 - (float(ctx.params["lr"]) - 0.1) ** 2
+            for step in range(5):
+                if not ctx.report(step=step, accuracy=acc * (step + 1) / 5):
+                    return
+
+        def spec_for(n):
+            return make_spec(
+                name="lossless-es", resume_policy=ResumePolicy.LONG_RUNNING,
+                max_trial_count=n, train_fn=ramp_trainer,
+                early_stopping=EarlyStoppingSpec(
+                    "medianstop",
+                    {"min_trials_required": "2", "start_step": "3"},
+                ),
+            )
+
+        spec = spec_for(4)
+        orch1 = Orchestrator(workdir=str(tmp_path))
+        exp1 = orch1.run(spec)
+        ms1 = make_early_stopper(spec)
+        ms1.bind_store(orch1.store)
+        rules_before = ms1.get_rules(exp1)
+        assert rules_before, "median rules must exist after 4 succeeded trials"
+
+        # process restart: fresh orchestrator, same workdir, raised budget
+        orch2 = Orchestrator(workdir=str(tmp_path))
+        exp2 = orch2.run(spec_for(6), resume=True)
+        assert len(exp2.trials) == 6
+
+        # the original trials' series survived in full (not one backfilled point)
+        first = next(iter(exp1.trials))
+        assert len(orch2.store.get(first, "accuracy")) == 5
+
+        # rules over the SAME trial subset are identical pre/post restart
+        ms2 = make_early_stopper(spec)
+        ms2.bind_store(orch2.store)
+        subset = SimpleNamespace(
+            trials={k: v for k, v in exp2.trials.items() if k in exp1.trials}
+        )
+        rules_after = ms2.get_rules(subset)
+        assert [(r.name, r.value, r.comparison, r.start_step) for r in rules_after] \
+            == [(r.name, r.value, r.comparison, r.start_step) for r in rules_before]
+
+
 class TestSuggesterStatePersistence:
     def test_pbt_state_round_trip(self, tmp_path):
         spec = make_spec(

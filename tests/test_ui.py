@@ -93,6 +93,51 @@ class TestUiEndpoints:
         assert status == 200 and logs
         assert logs[0]["metric_name"] == "accuracy"
 
+    def test_dashboard_carries_drilldown_renderers(self, served):
+        """The single-file page ships the per-trial metric chart and the
+        NAS graph renderer wired to the endpoints that feed them (the
+        reference UI's trial-detail charts + browser NAS render,
+        ``pkg/ui/v1beta1/nas.go`` / frontend trial views)."""
+        port, _ = served
+        _, _, body = _get(port, "/")
+        page = body.decode()
+        for hook in ("function metricChart", "function nasGraph",
+                     "showTrial", "trialdetail", "/metrics", "/nas?trial="):
+            assert hook in page, hook
+
+    def test_nas_endpoint_feeds_graph_for_trial_query(self, tmp_path):
+        """/api/experiment/<name>/nas?trial=<t> recovers an ENAS arc from
+        the trial's architecture assignment and returns render-ready
+        nodes/edges."""
+        import json as _json
+        import os
+
+        workdir = str(tmp_path)
+        os.makedirs(os.path.join(workdir, "nas-exp"))
+        with open(os.path.join(workdir, "nas-exp", "status.json"), "w") as f:
+            _json.dump({
+                "name": "nas-exp",
+                "condition": "MaxTrialsReached",
+                "trials": {
+                    "nas-exp-t0": {
+                        "name": "nas-exp-t0",
+                        "condition": "Succeeded",
+                        "assignments": {
+                            "architecture": _json.dumps([[2], [1, 1]]),
+                        },
+                    },
+                },
+            }, f)
+        ui = start_ui(workdir, MemoryObservationStore())
+        try:
+            status, _, body = _get(ui.port, "/api/experiment/nas-exp/nas?trial=nas-exp-t0")
+            g = json.loads(body)
+            assert status == 200 and g["type"] == "enas"
+            assert g["trial"] == "nas-exp-t0"
+            assert any(e["op"] == "skip" for e in g["edges"])
+        finally:
+            ui.stop()
+
     def test_unknown_routes_404(self, served):
         port, _ = served
         import urllib.error
