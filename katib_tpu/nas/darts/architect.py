@@ -50,6 +50,12 @@ class DartsHyper(NamedTuple):
     alpha_weight_decay: float = 1e-3
     total_steps: int = 1000  # for the cosine schedule
     unrolled: bool = True  # second-order (hessian correction) on/off
+    # expose the raw second-order alpha gradient in the step metrics —
+    # parity gates compare IT rather than the post-Adam alphas (Adam's
+    # sign-like first step turns sub-noise gradient elements into full
+    # ±alpha_lr divergences, so updated alphas are ill-conditioned
+    # evidence).  Off by default: it adds an alpha-sized tensor per step.
+    debug_alpha_grad: bool = False
 
 
 def make_search_step(
@@ -143,6 +149,8 @@ def make_search_step(
             "w_lr": lr,
             "grad_norm": gnorm,
         }
+        if hyper.debug_alpha_grad:
+            metrics["alpha_grad"] = a_grad
         return new_state, metrics
 
     if mesh is None:

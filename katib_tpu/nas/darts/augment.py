@@ -40,6 +40,7 @@ class GenotypeCell(nn.Module):
     reduction: bool = False
     reduction_prev: bool = False
     dtype: jnp.dtype = jnp.bfloat16
+    safe_conv: bool = False  # ops/depthwise.py module doc
 
     @nn.compact
     def __call__(self, s0, s1):
@@ -56,9 +57,10 @@ class GenotypeCell(nn.Module):
                 # cell inputs shrink spatially in reduction cells; states
                 # computed inside the cell are already reduced
                 stride = 2 if self.reduction and src < 2 else 1
-                out = build_op(op_name, self.channels, stride, self.dtype)(
-                    states[src]
-                )
+                out = build_op(
+                    op_name, self.channels, stride, self.dtype,
+                    safe=self.safe_conv,
+                )(states[src])
                 total = out if total is None else total + out
             states.append(total)
         return jnp.concatenate(states[2:], axis=-1)
@@ -75,6 +77,7 @@ class GenotypeNetwork(nn.Module):
     num_classes: int = 10
     stem_multiplier: int = 3
     dtype: jnp.dtype = jnp.bfloat16
+    safe_conv: bool = False  # ops/depthwise.py module doc
 
     @nn.compact
     def __call__(self, x):
@@ -86,6 +89,7 @@ class GenotypeNetwork(nn.Module):
                 reduction=reduction,
                 reduction_prev=reduction_prev,
                 dtype=self.dtype,
+                safe_conv=self.safe_conv,
             )
 
         return run_macro(
@@ -115,12 +119,15 @@ def train_genotype(
     """Train the discrete network; returns final held-out accuracy."""
     from katib_tpu.models.mnist import train_classifier
 
+    from katib_tpu.parallel.mesh import needs_safe_conv
+
     net = GenotypeNetwork(
         genotype=genotype,
         init_channels=init_channels,
         num_layers=num_layers,
         num_classes=dataset.num_classes,
         stem_multiplier=stem_multiplier,
+        safe_conv=needs_safe_conv(mesh),
     )
     return train_classifier(
         net,

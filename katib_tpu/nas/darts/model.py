@@ -73,6 +73,9 @@ class Cell(nn.Module):
     reduction: bool = False
     reduction_prev: bool = False
     dtype: jnp.dtype = jnp.bfloat16
+    # partitioner-safe conv forms for meshes with a model axis
+    # (ops/depthwise.py module doc)
+    safe_conv: bool = False
 
     @nn.compact
     def __call__(self, s0, s1, weights):
@@ -94,7 +97,8 @@ class Cell(nn.Module):
         def edge_group(states_group, w_rows, stride):
             # [k, N, H, W, C] states + [k, n_ops] weight rows -> [k, N, H', W', C]
             return VmappedMixedOp(
-                self.primitives, self.channels, stride, dtype=self.dtype
+                self.primitives, self.channels, stride, dtype=self.dtype,
+                safe=self.safe_conv,
             )(jnp.stack(states_group), w_rows)
 
         states = [s0, s1]
@@ -166,6 +170,9 @@ class DartsNetwork(nn.Module):
     stem_multiplier: int = 3
     remat: bool = True
     dtype: jnp.dtype = jnp.bfloat16
+    # select partitioner-safe conv forms; REQUIRED when training over a
+    # mesh with a model axis > 1 (ops/depthwise.py module doc)
+    safe_conv: bool = False
 
     @nn.compact
     def __call__(self, x, alphas: Alphas):
@@ -181,6 +188,7 @@ class DartsNetwork(nn.Module):
                 reduction=reduction,
                 reduction_prev=reduction_prev,
                 dtype=self.dtype,
+                safe_conv=self.safe_conv,
             )
             weights = w_reduce if reduction else w_normal
             return lambda s0, s1: cell(s0, s1, weights)

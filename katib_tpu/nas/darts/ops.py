@@ -24,6 +24,8 @@ from typing import Callable, Sequence
 import flax.linen as nn
 import jax.numpy as jnp
 
+from katib_tpu.ops.depthwise import DepthwiseConv, PointwiseConv
+
 DEFAULT_PRIMITIVES = (
     "none",
     "max_pooling_3x3",
@@ -53,14 +55,20 @@ class ReluConvBn(nn.Module):
     @nn.compact
     def __call__(self, x):
         x = nn.relu(x)
-        x = nn.Conv(
-            self.channels,
-            (self.kernel, self.kernel),
-            strides=(self.stride, self.stride),
-            padding="SAME",
-            use_bias=False,
-            dtype=self.dtype,
-        )(x)
+        if self.kernel == 1:
+            # the cell-preprocessing case; also safe under nn.vmap
+            x = PointwiseConv(
+                self.channels, stride=self.stride, dtype=self.dtype
+            )(x)
+        else:
+            x = nn.Conv(
+                self.channels,
+                (self.kernel, self.kernel),
+                strides=(self.stride, self.stride),
+                padding="SAME",
+                use_bias=False,
+                dtype=self.dtype,
+            )(x)
         return batch_norm(x)
 
 
@@ -71,23 +79,22 @@ class SepConv(nn.Module):
     kernel: int
     stride: int
     dtype: jnp.dtype = jnp.bfloat16
+    safe: bool = False
 
     @nn.compact
     def __call__(self, x):
         for i, stride in enumerate((self.stride, 1)):
             x = nn.relu(x)
-            x = nn.Conv(
-                x.shape[-1],
-                (self.kernel, self.kernel),
-                strides=(stride, stride),
-                padding="SAME",
-                feature_group_count=x.shape[-1],
-                use_bias=False,
-                dtype=self.dtype,
+            # shift-MAC depthwise, not nn.Conv(feature_group_count=C): the
+            # SPMD partitioner corrupts grouped-conv filter gradients on
+            # meshes with a model axis (ops/depthwise.py module doc)
+            x = DepthwiseConv(
+                kernel=self.kernel, stride=stride, dtype=self.dtype,
+                safe=self.safe,
             )(x)
-            x = nn.Conv(
-                self.channels, (1, 1), use_bias=False, dtype=self.dtype
-            )(x)
+            # einsum pointwise: a vmapped nn.Conv batches into the grouped
+            # form the partitioner corrupts (ops/depthwise.py module doc)
+            x = PointwiseConv(self.channels, dtype=self.dtype)(x)
             x = batch_norm(x)
         return x
 
@@ -100,21 +107,20 @@ class DilConv(nn.Module):
     stride: int
     dilation: int = 2
     dtype: jnp.dtype = jnp.bfloat16
+    safe: bool = False
 
     @nn.compact
     def __call__(self, x):
         x = nn.relu(x)
-        x = nn.Conv(
-            x.shape[-1],
-            (self.kernel, self.kernel),
-            strides=(self.stride, self.stride),
-            padding="SAME",
-            kernel_dilation=(self.dilation, self.dilation),
-            feature_group_count=x.shape[-1],
-            use_bias=False,
+        # shift-MAC depthwise (see SepConv / ops/depthwise.py)
+        x = DepthwiseConv(
+            kernel=self.kernel,
+            stride=self.stride,
+            dilation=self.dilation,
             dtype=self.dtype,
+            safe=self.safe,
         )(x)
-        x = nn.Conv(self.channels, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        x = PointwiseConv(self.channels, dtype=self.dtype)(x)
         return batch_norm(x)
 
 
@@ -128,12 +134,10 @@ class FactorizedReduce(nn.Module):
     @nn.compact
     def __call__(self, x):
         x = nn.relu(x)
-        a = nn.Conv(
-            self.channels // 2, (1, 1), strides=(2, 2), use_bias=False, dtype=self.dtype
-        )(x)
-        b = nn.Conv(
-            self.channels // 2, (1, 1), strides=(2, 2), use_bias=False, dtype=self.dtype
-        )(x[:, 1:, 1:, :])
+        a = PointwiseConv(self.channels // 2, stride=2, dtype=self.dtype)(x)
+        b = PointwiseConv(self.channels // 2, stride=2, dtype=self.dtype)(
+            x[:, 1:, 1:, :]
+        )
         # pad b back to a's spatial shape (off-by-one from the shifted slice)
         pad_h = a.shape[1] - b.shape[1]
         pad_w = a.shape[2] - b.shape[2]
@@ -178,17 +182,26 @@ class SkipConnect(nn.Module):
         return FactorizedReduce(self.channels, dtype=self.dtype)(x)
 
 
-def build_op(name: str, channels: int, stride: int, dtype=jnp.bfloat16) -> nn.Module:
-    """Primitive factory (reference ``OPS`` table, ``operations.py:18``)."""
+def build_op(
+    name: str, channels: int, stride: int, dtype=jnp.bfloat16, safe: bool = False
+) -> nn.Module:
+    """Primitive factory (reference ``OPS`` table, ``operations.py:18``).
+
+    ``safe`` selects the partitioner-safe depthwise formulation for meshes
+    with a model axis (ops/depthwise.py module doc)."""
     table: dict[str, Callable[[], nn.Module]] = {
         "none": lambda: Zero(stride),
         "avg_pooling_3x3": lambda: Pool("avg", stride),
         "max_pooling_3x3": lambda: Pool("max", stride),
         "skip_connection": lambda: SkipConnect(channels, stride, dtype=dtype),
-        "separable_convolution_3x3": lambda: SepConv(channels, 3, stride, dtype=dtype),
-        "separable_convolution_5x5": lambda: SepConv(channels, 5, stride, dtype=dtype),
-        "dilated_convolution_3x3": lambda: DilConv(channels, 3, stride, dtype=dtype),
-        "dilated_convolution_5x5": lambda: DilConv(channels, 5, stride, dtype=dtype),
+        "separable_convolution_3x3": lambda: SepConv(
+            channels, 3, stride, dtype=dtype, safe=safe),
+        "separable_convolution_5x5": lambda: SepConv(
+            channels, 5, stride, dtype=dtype, safe=safe),
+        "dilated_convolution_3x3": lambda: DilConv(
+            channels, 3, stride, dtype=dtype, safe=safe),
+        "dilated_convolution_5x5": lambda: DilConv(
+            channels, 5, stride, dtype=dtype, safe=safe),
     }
     if name not in table:
         raise ValueError(f"unknown primitive {name!r}; known: {sorted(table)}")
@@ -202,12 +215,13 @@ class MixedOp(nn.Module):
     channels: int
     stride: int
     dtype: jnp.dtype = jnp.bfloat16
+    safe: bool = False
 
     @nn.compact
     def __call__(self, x, weights):
         # weights: (n_ops,) softmax over this edge's alphas
         outs = [
-            build_op(p, self.channels, self.stride, self.dtype)(x)
+            build_op(p, self.channels, self.stride, self.dtype, safe=self.safe)(x)
             for p in self.primitives
         ]
         stacked = jnp.stack(outs, axis=0)  # (n_ops, N, H, W, C)

@@ -33,7 +33,7 @@ from katib_tpu.nas.darts.model import (
     init_alphas,
 )
 from katib_tpu.nas.darts.ops import DEFAULT_PRIMITIVES
-from katib_tpu.parallel.mesh import replicate, shard_batch
+from katib_tpu.parallel.mesh import needs_safe_conv, replicate, shard_batch
 from katib_tpu.parallel.train import accuracy, cross_entropy_loss, make_eval_step
 from katib_tpu.utils.booleans import parse_bool
 
@@ -94,6 +94,9 @@ def run_darts_search(
         # gradient passes — skipping recompute is a real speedup when
         # memory allows (remat=False)
         remat=remat,
+        # model-axis meshes need the partitioner-safe conv forms
+        # (ops/depthwise.py module doc)
+        safe_conv=needs_safe_conv(mesh),
     )
     rng = np.random.default_rng(seed)
     key = jax.random.PRNGKey(seed)

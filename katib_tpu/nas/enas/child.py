@@ -34,6 +34,7 @@ class _Op(nn.Module):
     name_: str
     channels: int
     dtype: jnp.dtype = jnp.bfloat16
+    safe_conv: bool = False  # ops/depthwise.py module doc
 
     @nn.compact
     def __call__(self, x):
@@ -43,15 +44,13 @@ class _Op(nn.Module):
             x = nn.Conv(self.channels, (k, k), padding="SAME", dtype=self.dtype)(x)
             x = nn.relu(x)
         elif n.startswith("separable_convolution"):
+            from katib_tpu.ops.depthwise import DepthwiseConv
+
             k = int(n.split("_")[-1][0])
-            x = nn.Conv(
-                x.shape[-1],
-                (k, k),
-                padding="SAME",
-                feature_group_count=x.shape[-1],
-                use_bias=False,
-                dtype=self.dtype,
-            )(x)
+            # safe=True switches to the shift-MAC depthwise for meshes with
+            # a model axis, where the grouped form's filter gradient is
+            # miscompiled (ops/depthwise.py module doc)
+            x = DepthwiseConv(kernel=k, dtype=self.dtype, safe=self.safe_conv)(x)
             x = nn.Conv(self.channels, (1, 1), dtype=self.dtype)(x)
             x = nn.relu(x)
         elif n.startswith("avg_pooling"):
@@ -76,6 +75,7 @@ class EnasChild(nn.Module):
     num_classes: int = 10
     pool_every: int = 3
     dtype: jnp.dtype = jnp.bfloat16
+    safe_conv: bool = False  # ops/depthwise.py module doc
 
     @nn.compact
     def __call__(self, x):
@@ -96,6 +96,7 @@ class EnasChild(nn.Module):
                 self.operations[op_idx],
                 self.channels,
                 dtype=self.dtype,
+                safe_conv=self.safe_conv,
                 name=f"op{layer}_{self.operations[op_idx]}",
             )(inp)
             outputs.append(x)
@@ -114,6 +115,7 @@ def child_from_arc(
     operations: Sequence[str] = DEFAULT_OPERATIONS,
     channels: int = 32,
     num_classes: int = 10,
+    safe_conv: bool = False,
 ) -> EnasChild:
     ops = tuple(int(o) for o in np.asarray(arc.ops))
     skips = tuple(
@@ -126,4 +128,5 @@ def child_from_arc(
         operations=tuple(operations),
         channels=channels,
         num_classes=num_classes,
+        safe_conv=safe_conv,
     )

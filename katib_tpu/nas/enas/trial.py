@@ -23,12 +23,17 @@ def enas_trial(ctx) -> None:
     num_layers = int(nn_config["num_layers"])
     operations = nn_config.get("operations")
 
+    from katib_tpu.parallel.mesh import needs_safe_conv
+
     arc = arc_from_json(arch, num_layers)
     kwargs = {"operations": tuple(operations)} if operations else {}
     model = child_from_arc(
         arc,
         channels=int(ctx.params.get("channels", 24)),
         num_classes=int(ctx.params.get("num_classes", 10)),
+        # model-axis meshes need the partitioner-safe depthwise form
+        # (ops/depthwise.py module doc)
+        safe_conv=needs_safe_conv(ctx.mesh),
         **kwargs,
     )
     n_train = ctx.params.get("n_train")

@@ -90,3 +90,14 @@ def replicate(tree, mesh: Mesh):
 
 def local_mesh_size(mesh: Mesh, axis: str = DATA_AXIS) -> int:
     return mesh.shape[axis] if axis in mesh.shape else 1
+
+
+def needs_safe_conv(mesh: Mesh | None) -> bool:
+    """True when grouped-convolution gradients cannot be trusted on this
+    mesh: XLA's SPMD partitioner miscompiles grouped-conv filter gradients
+    once the mesh carries a non-data axis of size > 1 (measured; see
+    ``katib_tpu/ops/depthwise.py``).  Model builders consult this to select
+    the partitioner-safe conv formulations."""
+    if mesh is None:
+        return False
+    return any(size > 1 for name, size in mesh.shape.items() if name != DATA_AXIS)

@@ -1,0 +1,138 @@
+"""Fetch + verify CIFAR-10 into ``KATIB_DATA_DIR/cifar10.npz``.
+
+The reference trains on real CIFAR-10 downloaded at container start
+(``darts-cnn-cifar10/run_trial.py:100-111`` torchvision download,
+``enas-cnn-cifar10/RunTrial.py:40-50``).  This image has zero egress, so
+the download leg cannot run here — but the moment a
+``cifar-10-python.tar.gz`` lands (mounted, copied, or fetched on a
+networked box), one command turns it into the npz every loader in the
+framework picks up automatically (``models/data.py`` ``_load_or_synthesize``),
+instantly upgrading every accuracy artifact from the synthetic stand-in to
+real data.
+
+Integrity is sha256-pinned: a wrong/corrupt archive fails loudly before
+anything is written.  Usage:
+
+    python scripts/fetch_cifar10.py                # download (needs egress)
+    python scripts/fetch_cifar10.py --tar /path/to/cifar-10-python.tar.gz
+    KATIB_DATA_DIR=~/data python scripts/fetch_cifar10.py --tar ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import io
+import os
+import pickle
+import sys
+import tarfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from katib_tpu.models.data import DATA_DIR_ENV  # noqa: E402
+
+URL = "https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz"
+# canonical digests of cifar-10-python.tar.gz (the md5 is the one torchvision
+# pins; the sha256 is of the same archive)
+SHA256 = "6d958be074577803d12ecdefd02955f39262c83c16fe9348329d7fe0b5c001ce"
+MD5 = "c58f30108f718f92721af3b95e74349a"
+
+
+def _digest(path: str, algo: str) -> str:
+    h = hashlib.new(algo)
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def verify(tar_path: str) -> None:
+    sha = _digest(tar_path, "sha256")
+    if sha != SHA256:
+        md5 = _digest(tar_path, "md5")
+        detail = f"sha256 {sha} != {SHA256}"
+        if md5 != MD5:
+            detail += f"; md5 {md5} != {MD5}"
+        raise SystemExit(f"integrity check FAILED for {tar_path}: {detail}")
+    print(f"sha256 ok: {sha}")
+
+
+def unpack(tar_path: str, expect_full: bool = True) -> dict[str, np.ndarray]:
+    """CIFAR python-version batches → the npz keys ``models/data.py`` loads.
+
+    Images stay uint8 HWC (the loader normalizes and keeps NHWC); labels
+    int32.  ``expect_full=False`` drops the 50k/10k size gate so tests can
+    exercise the pipeline on a miniature archive."""
+
+    def to_nhwc(raw: np.ndarray) -> np.ndarray:
+        return raw.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+
+    xs, ys = [], []
+    x_test = y_test = None
+    with tarfile.open(tar_path, "r:gz") as tf:
+        for member in tf.getmembers():
+            base = os.path.basename(member.name)
+            if not (base.startswith("data_batch_") or base == "test_batch"):
+                continue
+            fobj = tf.extractfile(member)
+            assert fobj is not None
+            batch = pickle.load(io.BytesIO(fobj.read()), encoding="bytes")
+            data = np.asarray(batch[b"data"], dtype=np.uint8)
+            labels = np.asarray(batch[b"labels"], dtype=np.int32)
+            if base == "test_batch":
+                x_test, y_test = to_nhwc(data), labels
+            else:
+                xs.append((base, data, labels))
+    if len(xs) != 5 or x_test is None:
+        raise SystemExit(
+            f"archive incomplete: {len(xs)} train batches, test={x_test is not None}"
+        )
+    xs.sort()  # data_batch_1..5 in order, independent of tar member order
+    x_train = to_nhwc(np.concatenate([d for _, d, _ in xs]))
+    y_train = np.concatenate([l for _, _, l in xs])
+    if expect_full:
+        assert x_train.shape == (50000, 32, 32, 3) and x_test.shape == (10000, 32, 32, 3)
+    return {
+        "x_train": x_train,
+        "y_train": y_train,
+        "x_test": x_test,
+        "y_test": y_test,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--tar", help="existing cifar-10-python.tar.gz (skips download)")
+    ap.add_argument(
+        "--out-dir",
+        default=os.environ.get(DATA_DIR_ENV, os.path.expanduser("~/.katib_tpu/data")),
+        help=f"target dir (default: ${DATA_DIR_ENV} or ~/.katib_tpu/data)",
+    )
+    args = ap.parse_args()
+
+    tar_path = args.tar
+    if tar_path is None:
+        import urllib.request
+
+        tar_path = os.path.join(args.out_dir, "cifar-10-python.tar.gz")
+        os.makedirs(args.out_dir, exist_ok=True)
+        if not os.path.exists(tar_path):
+            print(f"downloading {URL} ...")
+            urllib.request.urlretrieve(URL, tar_path)  # noqa: S310 (pinned URL)
+
+    verify(tar_path)
+    arrays = unpack(tar_path)
+    os.makedirs(args.out_dir, exist_ok=True)
+    out = os.path.join(args.out_dir, "cifar10.npz")
+    np.savez_compressed(out, **arrays)
+    print(f"wrote {out} ({os.path.getsize(out) / 1e6:.1f} MB)")
+    print(
+        f"set {DATA_DIR_ENV}={args.out_dir} and every cifar10 loader/demo "
+        "uses the real data automatically"
+    )
+
+
+if __name__ == "__main__":
+    main()

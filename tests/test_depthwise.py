@@ -1,0 +1,102 @@
+"""DepthwiseConv/PointwiseConv (ops/depthwise.py): partitioner-safe convs.
+
+Two properties pinned:
+1. Numerical equality with ``nn.Conv(feature_group_count=C)`` on a single
+   device — same math, same kernel shape, so the shift-MAC form is a
+   drop-in.
+2. Gradient parity across a dp x model mesh — the exact configuration
+   where the grouped-conv formulation's filter gradient comes back 100%
+   wrong from the SPMD partitioner (measured: max|diff| == max|grad| vs an
+   f64 ground truth on jax 0.9.0 CPU).  This test is the regression gate
+   for that miscompile.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from katib_tpu.ops.depthwise import DepthwiseConv
+from katib_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    make_mesh,
+    replicate,
+    replicated,
+)
+
+
+class TestEqualsGroupedConv:
+    @pytest.mark.parametrize("kernel,stride,dilation", [
+        (3, 1, 1), (3, 2, 1), (5, 1, 1), (3, 1, 2), (5, 2, 2),
+    ])
+    def test_forward_matches(self, kernel, stride, dilation):
+        c = 6
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 12, 12, c), jnp.float32)
+        dw = DepthwiseConv(kernel=kernel, stride=stride, dilation=dilation,
+                           dtype=jnp.float32, safe=True)
+        grouped = nn.Conv(
+            c, (kernel, kernel), strides=(stride, stride), padding="SAME",
+            kernel_dilation=(dilation, dilation), feature_group_count=c,
+            use_bias=False, dtype=jnp.float32,
+        )
+        kern = jax.random.normal(jax.random.PRNGKey(2), (kernel, kernel, 1, c))
+        out_dw = dw.apply({"params": {"kernel": kern}}, x)
+        out_g = grouped.apply({"params": {"kernel": kern}}, x)
+        # atol covers 25-tap summation-order noise on O(10) activations
+        np.testing.assert_allclose(
+            np.asarray(out_dw), np.asarray(out_g), rtol=1e-5, atol=1e-5
+        )
+
+    def test_init_shape_and_scale(self):
+        dw = DepthwiseConv(kernel=3, dtype=jnp.float32)
+        safe = DepthwiseConv(kernel=3, dtype=jnp.float32, safe=True)
+        x0 = jnp.zeros((1, 8, 8, 5))
+        # flipping `safe` must never change the parameter tree
+        p_fast = dw.init(jax.random.PRNGKey(0), x0)
+        p_safe = safe.init(jax.random.PRNGKey(0), x0)
+        assert jax.tree_util.tree_structure(p_fast) == jax.tree_util.tree_structure(p_safe)
+        np.testing.assert_array_equal(
+            np.asarray(p_fast["params"]["kernel"]),
+            np.asarray(p_safe["params"]["kernel"]),
+        )
+        x = jnp.zeros((1, 8, 8, 5))
+        params = dw.init(jax.random.PRNGKey(0), x)
+        assert params["params"]["kernel"].shape == (3, 3, 1, 5)
+        assert params["params"]["kernel"].dtype == jnp.float32
+
+
+class TestMeshGradParity:
+    def test_filter_grad_parity_on_model_axis_mesh(self):
+        """The regression the module exists for: kernel gradients on a
+        dp x model mesh equal the single-device gradients."""
+        devs = jax.devices()
+        if len(devs) < 8:
+            pytest.skip("needs the 8-device virtual mesh")
+        c = 8
+        x = jax.random.normal(jax.random.PRNGKey(7), (8, 12, 12, c), jnp.float32)
+        dw = DepthwiseConv(kernel=3, dtype=jnp.float32, safe=True)
+        params = dw.init(jax.random.PRNGKey(0), x[:1])
+
+        def loss(p, xb):
+            out = dw.apply(p, xb)
+            return (out * out).mean()
+
+        g0 = jax.device_get(jax.jit(jax.grad(loss))(params, x))
+        mesh = make_mesh({DATA_AXIS: 4, MODEL_AXIS: 2}, devices=devs[:8])
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        ss = replicated(mesh)
+        bs = NamedSharding(mesh, PartitionSpec(DATA_AXIS))
+        gm = jax.jit(jax.grad(loss), in_shardings=(ss, bs), out_shardings=ss)
+        g42 = jax.device_get(gm(replicate(params, mesh), jax.device_put(x, bs)))
+        np.testing.assert_allclose(
+            np.asarray(g0["params"]["kernel"]),
+            np.asarray(g42["params"]["kernel"]),
+            rtol=1e-5, atol=1e-7,
+            err_msg="depthwise filter gradient diverges on the model-axis "
+                    "mesh — the partitioner regression is back",
+        )
