@@ -494,7 +494,26 @@ tr.sel{background:#eef4ff} tbody tr{cursor:pointer}
 </style></head><body>
 <h1>katib-tpu experiments</h1>
 <details id="create"><summary>create experiment</summary>
-<p>Paste a Katib-style experiment YAML (black-box <code>trialTemplate.command</code> trials).</p>
+<fieldset style="border:1px solid #ddd;margin:.5rem 0;padding:.6rem">
+<legend>wizard (fills the YAML below — edit freely before running)</legend>
+<input id="w_name" placeholder="name" size="14">
+<select id="w_algo"><option>random</option><option>grid</option><option>tpe</option>
+<option>multivariate-tpe</option><option>bayesianoptimization</option><option>cmaes</option>
+<option>sobol</option><option>hyperband</option><option>asha</option><option>pbt</option></select>
+<select id="w_otype"><option>minimize</option><option>maximize</option></select>
+<input id="w_metric" placeholder="objective metric" size="12" value="loss">
+<input id="w_goal" placeholder="goal (opt)" size="8">
+<input id="w_max" placeholder="max trials" size="6" value="12">
+<input id="w_par" placeholder="parallel" size="5" value="3">
+<table id="w_params" style="width:auto;margin:.4rem 0"><thead><tr><th>param</th><th>type</th>
+<th>min</th><th>max</th><th>list (comma)</th></tr></thead><tbody></tbody></table>
+<button id="w_addp" type="button">+ parameter</button>
+<div><small>trial command, one argument per line (use ${trialParameters.&lt;name&gt;}):</small><br>
+<textarea id="w_cmd" rows="3" style="width:100%;font-family:monospace">python
+-c
+print("loss=" + str((${trialParameters.lr}-0.03)**2))</textarea></div>
+<button id="w_build" type="button">build YAML</button>
+</fieldset>
 <textarea id="yaml" rows="14" style="width:100%;font-family:monospace"></textarea><br>
 <input id="token" placeholder="bearer token (if required)" style="width:18rem">
 <button id="submit">run</button> <span id="createmsg"></span></details>
@@ -527,6 +546,55 @@ async function refresh(){
 }
 document.getElementById('submit').onclick=()=>
   act('/api/experiments','POST',JSON.stringify({yaml:document.getElementById('yaml').value}));
+// -- creation wizard: assembles the Katib-style YAML client-side ----------
+function addParamRow(name='',type='double',min='',max='',list=''){
+  const tb=document.querySelector('#w_params tbody');
+  const tr=document.createElement('tr');
+  tr.innerHTML=`<td><input size="8" class="p_n" value="${esc(name)}"></td>`+
+    `<td><select class="p_t"><option>double</option><option>int</option>`+
+    `<option>discrete</option><option>categorical</option></select></td>`+
+    `<td><input size="6" class="p_lo" value="${esc(min)}"></td>`+
+    `<td><input size="6" class="p_hi" value="${esc(max)}"></td>`+
+    `<td><input size="12" class="p_ls" value="${esc(list)}"></td>`;
+  tr.querySelector('.p_t').value=type;
+  tb.appendChild(tr);
+}
+document.getElementById('w_addp').onclick=()=>addParamRow();
+addParamRow('lr','double','0.01','0.05');
+document.getElementById('w_build').onclick=()=>{
+  const v=id=>document.getElementById(id).value.trim();
+  const q=JSON.stringify; // YAML-safe scalar quoting
+  const msg=[];
+  let y='apiVersion: kubeflow.org/v1beta1\nkind: Experiment\nmetadata:\n'+
+    `  name: ${q(v('w_name')||'my-experiment')}\nspec:\n  objective:\n`+
+    `    type: ${v('w_otype')}\n    objectiveMetricName: ${q(v('w_metric'))}\n`;
+  // numeric fields are parsed client-side so stray text can't corrupt
+  // the YAML (an unquoted ':' or '#' would truncate or break parsing)
+  const goal=parseFloat(v('w_goal'));
+  if(v('w_goal')&&!isNaN(goal))y+=`    goal: ${goal}\n`;
+  else if(v('w_goal'))msg.push(`goal ${q(v('w_goal'))} is not a number — omitted`);
+  y+=`  algorithm:\n    algorithmName: ${v('w_algo')}\n`+
+    `  parallelTrialCount: ${parseInt(v('w_par'))||3}\n`+
+    `  maxTrialCount: ${parseInt(v('w_max'))||12}\n`+
+    '  parameters:\n';
+  document.querySelectorAll('#w_params tbody tr').forEach(tr=>{
+    const g=c=>tr.querySelector(c).value.trim();
+    if(!g('.p_n'))return;
+    if(!g('.p_ls')&&(!g('.p_lo')||!g('.p_hi'))){
+      msg.push(`parameter ${q(g('.p_n'))} needs min+max or a list — skipped`);
+      return;
+    }
+    y+=`    - name: ${q(g('.p_n'))}\n      parameterType: ${g('.p_t')}\n`;
+    if(g('.p_ls'))
+      y+=`      feasibleSpace: {list: [${g('.p_ls').split(',').map(s=>q(s.trim())).join(', ')}]}\n`;
+    else
+      y+=`      feasibleSpace: {min: ${q(g('.p_lo'))}, max: ${q(g('.p_hi'))}}\n`;
+  });
+  y+='  trialTemplate:\n    command:\n'+
+    v('w_cmd').split('\n').filter(l=>l.length).map(l=>`      - ${q(l)}`).join('\n')+'\n';
+  document.getElementById('yaml').value=y;
+  document.getElementById('createmsg').textContent=msg.join('; ');
+};
 function sparkline(rows){
   if(!rows||!rows.length)return '';
   const xs=rows.map(r=>r.elapsed_s),ys=rows.map(r=>r.objective_value);
@@ -609,12 +677,16 @@ function nasGraph(g){
 let trialOf=null; // which experiment the drill-down panel belongs to
 async function showTrial(exp,trial){
   trialOf=exp;
-  const [m,nas]=await Promise.all([
-    j('/api/trial/'+encodeURIComponent(trial)+'/metrics'),
-    j('/api/experiment/'+encodeURIComponent(exp)+'/nas?trial='+encodeURIComponent(trial))]);
+  const t=encodeURIComponent(trial);
+  const [m,nas,logs]=await Promise.all([
+    j('/api/trial/'+t+'/metrics'),
+    j('/api/experiment/'+encodeURIComponent(exp)+'/nas?trial='+t),
+    j('/api/trial/'+t+'/logs')]);
   document.getElementById('trialdetail').innerHTML=
     `<h2>${esc(trial)} — metrics</h2>`+metricChart(Array.isArray(m)?m:[])+
-    (nas&&nas.nodes?nasGraph(nas):'');
+    (nas&&nas.nodes?nasGraph(nas):'')+
+    (logs&&logs.log?`<details><summary>captured log (${esc(trial)})</summary>`+
+      `<pre>${esc(logs.log.slice(-20000))}</pre></details>`:'');
 }
 async function show(name,re=true){
   current=name;

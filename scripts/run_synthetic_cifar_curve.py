@@ -31,8 +31,15 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from _common import REPO, setup_jax, write_artifact  # noqa: E402
 
-force_cpu = os.environ.get("JAX_PLATFORMS") != "axon"
-jax = setup_jax(force_platform="cpu" if force_cpu else None, compile_cache=True)
+# CPU by default: the ambient env always exports JAX_PLATFORMS=axon on
+# this box, so "honor ambient" would aim every curve run at a possibly
+# wedged pool (and collide with the probe loop's single grant).
+# CURVE_TPU=1 opts into the chip.
+sys.path.insert(0, REPO)
+from katib_tpu.utils.booleans import parse_bool  # noqa: E402
+
+on_tpu = parse_bool(os.environ.get("CURVE_TPU"))
+jax = setup_jax(force_platform=None if on_tpu else "cpu", compile_cache=True)
 
 sys.path.insert(0, REPO)
 
