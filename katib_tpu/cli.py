@@ -13,6 +13,7 @@ observation store:
 - ``export <experiment>``     trials as CSV/JSONL for analysis
 - ``ui``                      serve the REST API + HTML dashboard (TLS optional)
 - ``suggest-server``          suggestion-as-a-service daemon
+- ``db-manager``              native observation-log daemon (``--db`` = durable journal)
 - ``conformance``             packaged e2e invariants check (conformance/run.sh parity)
 - ``doctor``                  environment report (devices, native runtime)
 """
@@ -333,6 +334,51 @@ def cmd_conformance(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_db_manager(args: argparse.Namespace) -> int:
+    """Run the native db-manager daemon standalone (the reference ships it
+    as its own binary, ``cmd/db-manager/v1beta1/main.go:51``).  ``--db``
+    enables the append-only frame journal: acked mutations survive kill -9
+    and replay on the next start.  Blocks until interrupted; clients point
+    a ``store: {backend: remote, host, port}`` config (or
+    ``RemoteObservationStore``) at the printed address."""
+    import signal as _signal
+
+    from katib_tpu.native.dbmanager import spawn_db_manager
+
+    # PDEATHSIG: the daemon dies with this wrapper, so even a SIGKILLed CLI
+    # can't orphan a daemon holding the port + journal file
+    handle = spawn_db_manager(
+        host=args.host, port=args.port, db_path=args.db,
+        kill_on_parent_exit=True,
+    )
+    print(
+        f"katib-tpu db-manager: {args.host}:{handle.port} "
+        f"({'journal: ' + args.db if args.db else 'in-memory'})",
+        flush=True,
+    )
+    stopped_by_us = False
+
+    def _on_term(signum, frame):
+        nonlocal stopped_by_us
+        stopped_by_us = True
+        # signal only — calling proc.wait() here would deadlock on the
+        # Popen lock the interrupted main-thread wait() already holds
+        handle.proc.terminate()
+
+    _signal.signal(_signal.SIGTERM, _on_term)
+    try:
+        handle.proc.wait()
+    except KeyboardInterrupt:
+        stopped_by_us = True
+        handle.stop()
+    # a shutdown we initiated is a clean exit, whatever signal killed the
+    # daemon; only an unprompted daemon death propagates as failure
+    if stopped_by_us:
+        return 0
+    rc = handle.proc.returncode
+    return rc if rc and rc > 0 else (1 if rc else 0)
+
+
 def cmd_suggest_server(args: argparse.Namespace) -> int:
     """Run the suggestion-as-a-service daemon (the reference's per-experiment
     algorithm Deployment entrypoint, ``cmd/suggestion/*/v1beta1/main.py``).
@@ -529,6 +575,17 @@ def main(argv: list[str] | None = None) -> int:
     p = sub.add_parser("conformance", help="packaged e2e invariants check")
     p.add_argument("--max-trials", type=int, default=8)
     p.set_defaults(fn=cmd_conformance)
+
+    p = sub.add_parser(
+        "db-manager", help="run the native observation-log daemon"
+    )
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=6789)
+    p.add_argument(
+        "--db", default=None,
+        help="journal file: acked mutations survive crashes and replay on start",
+    )
+    p.set_defaults(fn=cmd_db_manager)
 
     p = sub.add_parser(
         "suggest-server", help="run the suggestion-as-a-service daemon"

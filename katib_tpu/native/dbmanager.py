@@ -148,14 +148,32 @@ class DbManagerHandle:
             self.proc.wait()
 
 
+def _set_pdeathsig() -> None:
+    """Child-side: die with SIGKILL when the parent exits (Linux prctl).
+    Keeps a daemon spawned by a CLI wrapper from outliving it — even a
+    SIGKILLed wrapper can't orphan a daemon holding the port + journal."""
+    import ctypes
+    import signal
+
+    PR_SET_PDEATHSIG = 1
+    ctypes.CDLL("libc.so.6", use_errno=True).prctl(
+        PR_SET_PDEATHSIG, signal.SIGKILL
+    )
+
+
 def spawn_db_manager(
-    host: str = "127.0.0.1", port: int = 0, db_path: str | None = None
+    host: str = "127.0.0.1",
+    port: int = 0,
+    db_path: str | None = None,
+    kill_on_parent_exit: bool = False,
 ) -> DbManagerHandle:
     """Launch the daemon (port 0 = ephemeral); blocks until it listens.
 
     ``db_path`` enables the append-only frame journal: acked mutations
     survive a daemon crash and are replayed on the next start (parity with
     the reference daemon's persisted SQL table, ``mysql/init.go:35``).
+    ``kill_on_parent_exit`` ties the daemon's lifetime to the caller via
+    ``PR_SET_PDEATHSIG`` (the CLI wrapper uses it).
     """
     if not ensure_built():
         from katib_tpu.native.build import build_error
@@ -168,6 +186,7 @@ def spawn_db_manager(
         cmd,
         stdout=subprocess.PIPE,
         text=True,
+        preexec_fn=_set_pdeathsig if kill_on_parent_exit else None,
     )
     assert proc.stdout is not None
     deadline = time.monotonic() + 10.0

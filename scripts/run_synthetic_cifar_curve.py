@@ -41,8 +41,6 @@ from katib_tpu.utils.booleans import parse_bool  # noqa: E402
 on_tpu = parse_bool(os.environ.get("CURVE_TPU"))
 jax = setup_jax(force_platform=None if on_tpu else "cpu", compile_cache=True)
 
-sys.path.insert(0, REPO)
-
 
 def nearest_class_mean_ceiling(ds) -> float:
     """Accuracy of classifying test points by nearest class mean of the

@@ -290,6 +290,68 @@ spec:
         assert "no trial command" in capsys.readouterr().err
 
 
+class TestDbManagerCommand:
+    def test_daemon_serves_and_journals(self, tmp_path):
+        """``katib-tpu db-manager --db`` runs the native daemon standalone
+        (reference ``cmd/db-manager`` parity); a client round-trips points
+        and they survive a SIGKILL + restart on the same journal."""
+        import os
+        import signal
+        import subprocess
+        import time
+
+        from katib_tpu.native import native_available
+        from katib_tpu.native.dbmanager import RemoteObservationStore
+
+        if not native_available():
+            pytest.skip("native runtime unavailable")
+        db = str(tmp_path / "obs.journal")
+
+        def launch():
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "katib_tpu", "db-manager",
+                 "--port", "0", "--db", db],
+                stdout=subprocess.PIPE, text=True,
+            )
+            line = proc.stdout.readline()
+            assert "db-manager:" in line, line
+            port = int(line.split()[2].rsplit(":", 1)[1])
+            return proc, port
+
+        proc, port = launch()
+        try:
+            client = RemoteObservationStore(port=port)
+            client.report_point("t", "loss", 0.5, step=1)
+            client.close()
+        finally:
+            # SIGKILL the wrapper: PDEATHSIG must take the native daemon
+            # down with it (no orphan holding the port/journal), making
+            # this a REAL daemon-crash durability exercise
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            try:
+                probe = RemoteObservationStore(port=port, timeout=0.3)
+                probe.ping()
+                probe.close()
+                time.sleep(0.1)  # daemon still up; PDEATHSIG is async
+            except (ConnectionError, OSError):
+                break
+        else:
+            raise AssertionError("daemon outlived its SIGKILLed CLI wrapper")
+
+        proc, port = launch()
+        try:
+            client = RemoteObservationStore(port=port)
+            assert [(l.value, l.step) for l in client.get("t", "loss")] == [(0.5, 1)]
+            client.close()
+        finally:
+            proc.terminate()
+            proc.wait()
+            assert proc.returncode == 0, proc.returncode  # clean SIGTERM exit
+
+
 class TestObservability:
     def test_counters_and_render(self):
         from katib_tpu.utils.observability import MetricsRegistry
