@@ -58,6 +58,14 @@ target via the pip-installed ``libtpu.so`` (the plugin's documented
 local-AOT path) and only *execution* crosses the relay.  The env var must
 be set before interpreter start (the axon sitecustomize registers the PJRT
 plugin at boot), which is exactly what spawning a child process allows.
+
+Local compile has one hard failure mode: the terminal refuses executables
+from a client whose ``libtpu`` build differs from its own
+("libtpu version mismatch ... FAILED_PRECONDITION", seen live when the
+pool rolled to an older build than the pip wheel).  The retry loop detects
+that signature in the child's stderr and re-runs the attempt with
+terminal-side compile — correct by construction (the terminal compiles
+with its own libtpu) at the cost of tunnel-compile latency.
 """
 
 from __future__ import annotations
@@ -415,10 +423,14 @@ def _run_attempt(
     # local AOT compile by default — the terminal-side compile path is both
     # slow (minutes/op over the tunnel) and wedge-prone (see module doc).
     # The ambient env exports PALLAS_AXON_REMOTE_COMPILE=1, so this must
-    # override, not setdefault; KATIB_REMOTE_COMPILE=1 restores remote.
-    child_env["PALLAS_AXON_REMOTE_COMPILE"] = (
-        "1" if remote_compile_requested() else "0"
+    # override, not setdefault; KATIB_REMOTE_COMPILE=1 restores remote
+    # (read from child_env too so the retry loop can flip it per-attempt
+    # after a libtpu-mismatch failure).
+    remote = (
+        child_env.get("KATIB_REMOTE_COMPILE", "") not in ("", "0")
+        or remote_compile_requested()
     )
+    child_env["PALLAS_AXON_REMOTE_COMPILE"] = "1" if remote else "0"
     proc = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), "--child"],
         stdout=subprocess.PIPE,
@@ -477,8 +489,9 @@ def main() -> None:
             )
 
     last_rc, last_err = 0, ""
-    env = None
+    extra_env: dict[str, str] = {}
     for attempt in range(1, retries + 1):
+        env = {**os.environ, **extra_env} if extra_env else None
         rc, result, err = _run_attempt(attempt_timeout, env=env)
         if result is not None:
             if aot_block is not None:
@@ -487,16 +500,29 @@ def main() -> None:
             return
         last_rc, last_err = rc, err
         wedged = rc in (3, -9)
+        mismatch = "libtpu version mismatch" in (err or "")
         print(
             f"bench: attempt {attempt}/{retries} failed rc={rc}"
             + (" (device init blocked — TPU pool wedged?)" if wedged else "")
             + (f"\n{err}" if err else ""),
             file=sys.stderr,
         )
-        if (
+        if mismatch and attempt < retries:
+            # the terminal runs a different libtpu build than the local
+            # wheel and rejects locally-compiled executables outright;
+            # compiling on the terminal sidesteps the version skew
+            print(
+                "bench: local libtpu does not match the terminal runtime; "
+                "switching to terminal-side compile (KATIB_REMOTE_COMPILE=1)",
+                file=sys.stderr,
+            )
+            extra_env["KATIB_REMOTE_COMPILE"] = "1"
+            continue  # config flip, not pool recovery — no backoff needed
+        elif (
             attempt < retries
             and not wedged
             and os.environ.get("BENCH_REMAT", "") in ("", "0")
+            and "BENCH_REMAT" not in extra_env
         ):
             # the child ran but crashed — plausibly HBM exhaustion from the
             # no-recompute default; retry with activation checkpointing
@@ -505,8 +531,7 @@ def main() -> None:
                 "in case the failure was memory",
                 file=sys.stderr,
             )
-            env = dict(os.environ)
-            env["BENCH_REMAT"] = "1"
+            extra_env["BENCH_REMAT"] = "1"
         if attempt < retries:
             time.sleep(backoff)
     print(

@@ -15,6 +15,7 @@ from __future__ import annotations
 import socket
 import struct
 import subprocess
+import sys
 import threading
 import time
 from typing import Iterable
@@ -148,17 +149,31 @@ class DbManagerHandle:
             self.proc.wait()
 
 
+# resolved at import time: dlopen/symbol lookup must not run inside the
+# post-fork preexec_fn (allocator locks held by other threads), and a
+# platform without prctl (macOS, musl without libc.so.6) degrades to
+# "no lifetime tie" instead of killing every spawn attempt
+try:
+    import ctypes
+    import ctypes.util
+
+    _libc = ctypes.CDLL(
+        ctypes.util.find_library("c") or "libc.so.6", use_errno=True
+    )
+    _prctl = _libc.prctl if sys.platform.startswith("linux") else None
+except OSError:  # pragma: no cover - non-glibc platforms
+    _prctl = None
+
+
 def _set_pdeathsig() -> None:
     """Child-side: die with SIGKILL when the parent exits (Linux prctl).
     Keeps a daemon spawned by a CLI wrapper from outliving it — even a
     SIGKILLed wrapper can't orphan a daemon holding the port + journal."""
-    import ctypes
     import signal
 
     PR_SET_PDEATHSIG = 1
-    ctypes.CDLL("libc.so.6", use_errno=True).prctl(
-        PR_SET_PDEATHSIG, signal.SIGKILL
-    )
+    if _prctl is not None:
+        _prctl(PR_SET_PDEATHSIG, signal.SIGKILL)
 
 
 def spawn_db_manager(

@@ -35,7 +35,6 @@ from _common import REPO, setup_jax, write_artifact  # noqa: E402
 # this box, so "honor ambient" would aim every curve run at a possibly
 # wedged pool (and collide with the probe loop's single grant).
 # CURVE_TPU=1 opts into the chip.
-sys.path.insert(0, REPO)
 from katib_tpu.utils.booleans import parse_bool  # noqa: E402
 
 on_tpu = parse_bool(os.environ.get("CURVE_TPU"))
