@@ -15,9 +15,69 @@ if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
 
+_COMPILE_MODE_CACHE = os.path.join(REPO, ".compile_mode.json")
+_COMPILE_MODE_TTL_S = 4 * 3600.0
+
+
+def _local_compile_probe() -> bool | None:
+    """Does a locally-compiled trivial op actually execute on the pool?
+
+    The pool terminal refuses executables from a client whose ``libtpu``
+    build differs from its own ("libtpu version mismatch", FAILED_
+    PRECONDITION — seen live when the pool rolled to an older build than
+    the pip wheel).  Local compile is all-or-nothing under that skew, so
+    one 1-element add answers for every program.  Returns ``True`` (local
+    ok), ``False`` (mismatch — use terminal-side compile), or ``None``
+    (inconclusive: pool wedged / probe timeout — keep the default).
+    The verdict is cached in ``.compile_mode.json`` for 4h because the
+    probe costs a device claim (~1 min through the relay).
+    """
+    import subprocess
+    import time
+
+    try:
+        with open(_COMPILE_MODE_CACHE) as f:
+            cached = json.load(f)
+        if time.time() - cached["ts"] < _COMPILE_MODE_TTL_S:
+            return cached["local_ok"]
+    except (OSError, ValueError, KeyError):
+        pass
+    env = dict(os.environ)
+    env["PALLAS_AXON_REMOTE_COMPILE"] = "0"
+    env.pop("KATIB_REMOTE_COMPILE", None)
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import jax, jax.numpy as jnp;"
+                "print('PROBE_OK', jnp.add(1, 1))",
+            ],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=float(os.environ.get("KATIB_COMPILE_PROBE_TIMEOUT", "240")),
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    if "PROBE_OK" in (proc.stdout or ""):
+        verdict: bool | None = True
+    elif "libtpu version mismatch" in (proc.stderr or ""):
+        verdict = False
+    else:
+        return None
+    try:
+        with open(_COMPILE_MODE_CACHE, "w") as f:
+            json.dump({"local_ok": verdict, "ts": time.time()}, f)
+    except OSError:
+        pass
+    return verdict
+
+
 def ensure_local_compile() -> None:
     """Re-exec with ``PALLAS_AXON_REMOTE_COMPILE=0`` if the ambient env asks
-    for terminal-side compile.
+    for terminal-side compile — unless the pool's libtpu build rejects
+    locally-compiled executables, in which case stay on terminal-side.
 
     The axon sitecustomize registers the PJRT plugin at interpreter boot
     with whatever the env said THEN, so flipping the variable here is too
@@ -26,11 +86,20 @@ def ensure_local_compile() -> None:
     ``libtpu.so`` client-side; only execution crosses the relay).  The
     remote path was measured at minutes per trivial op through the tunnel
     and wedged the session on the full-size bilevel program — see
-    ``bench.py``'s module doc.  ``KATIB_REMOTE_COMPILE=1`` opts back in.
+    ``bench.py``'s module doc.  ``KATIB_REMOTE_COMPILE=1`` opts back in
+    explicitly; otherwise :func:`_local_compile_probe` decides (a version
+    skew between the pip ``libtpu`` and the pool terminal makes local
+    compile hard-fail at first execution, so probing beats crashing an
+    hour into a run).
     """
     if remote_compile_requested():
         return
     if os.environ.get("PALLAS_AXON_REMOTE_COMPILE") == "1":
+        if _local_compile_probe() is False:
+            # record the decision for child processes (the bench children
+            # and subprocess trials consult KATIB_REMOTE_COMPILE)
+            os.environ["KATIB_REMOTE_COMPILE"] = "1"
+            return  # interpreter already registered terminal-side compile
         os.environ["PALLAS_AXON_REMOTE_COMPILE"] = "0"
         # orig_argv preserves interpreter options (-u, -m, -X ...) that
         # sys.argv has already stripped

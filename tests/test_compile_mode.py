@@ -1,0 +1,144 @@
+"""Compile-locality probe: local-AOT vs terminal-side compile selection.
+
+The axon pool terminal refuses executables compiled with a libtpu build
+different from its own ("libtpu version mismatch"); ``scripts/_common``
+probes once, caches the verdict, and steers ``ensure_local_compile``.
+These tests pin the verdict parsing, the cache round-trip, and the
+inconclusive paths without ever touching a device.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"))
+
+import _common  # noqa: E402
+
+
+class _FakeCompleted:
+    def __init__(self, stdout="", stderr=""):
+        self.stdout, self.stderr = stdout, stderr
+
+
+@pytest.fixture
+def cache_path(tmp_path, monkeypatch):
+    path = str(tmp_path / "compile_mode.json")
+    monkeypatch.setattr(_common, "_COMPILE_MODE_CACHE", path)
+    return path
+
+
+def test_probe_local_ok(cache_path, monkeypatch):
+    monkeypatch.setattr(
+        subprocess, "run", lambda *a, **k: _FakeCompleted(stdout="PROBE_OK 2\n")
+    )
+    assert _common._local_compile_probe() is True
+    cached = json.load(open(cache_path))
+    assert cached["local_ok"] is True
+
+
+def test_probe_mismatch_flips_to_remote(cache_path, monkeypatch):
+    monkeypatch.setattr(
+        subprocess,
+        "run",
+        lambda *a, **k: _FakeCompleted(
+            stderr="jax.errors.JaxRuntimeError: FAILED_PRECONDITION: "
+            "libtpu version mismatch: terminal has ..."
+        ),
+    )
+    assert _common._local_compile_probe() is False
+    assert json.load(open(cache_path))["local_ok"] is False
+
+
+def test_probe_inconclusive_not_cached(cache_path, monkeypatch):
+    monkeypatch.setattr(
+        subprocess, "run", lambda *a, **k: _FakeCompleted(stderr="some other crash")
+    )
+    assert _common._local_compile_probe() is None
+    assert not os.path.exists(cache_path)
+
+    def boom(*a, **k):
+        raise subprocess.TimeoutExpired(cmd="x", timeout=1)
+
+    monkeypatch.setattr(subprocess, "run", boom)
+    assert _common._local_compile_probe() is None
+
+
+def test_probe_cache_short_circuits_subprocess(cache_path, monkeypatch):
+    import time
+
+    with open(cache_path, "w") as f:
+        json.dump({"local_ok": False, "ts": time.time()}, f)
+
+    def boom(*a, **k):  # pragma: no cover - must not be reached
+        raise AssertionError("probe subprocess ran despite fresh cache")
+
+    monkeypatch.setattr(subprocess, "run", boom)
+    assert _common._local_compile_probe() is False
+
+
+def test_probe_stale_cache_reprobes(cache_path, monkeypatch):
+    with open(cache_path, "w") as f:
+        json.dump({"local_ok": False, "ts": 0.0}, f)
+    monkeypatch.setattr(
+        subprocess, "run", lambda *a, **k: _FakeCompleted(stdout="PROBE_OK 2\n")
+    )
+    assert _common._local_compile_probe() is True
+
+
+def test_probe_env_forces_local_aot_off_remote(cache_path, monkeypatch):
+    """The probe child must run with local compile and no opt-back-in."""
+    seen = {}
+
+    def capture(cmd, env=None, **k):
+        seen["env"] = env
+        return _FakeCompleted(stdout="PROBE_OK 2\n")
+
+    monkeypatch.setattr(subprocess, "run", capture)
+    monkeypatch.setenv("KATIB_REMOTE_COMPILE", "1")
+    _common._local_compile_probe()
+    assert seen["env"]["PALLAS_AXON_REMOTE_COMPILE"] == "0"
+    assert "KATIB_REMOTE_COMPILE" not in seen["env"]
+
+
+def test_ensure_local_compile_stays_remote_on_mismatch(cache_path, monkeypatch):
+    """Mismatch verdict => no re-exec, KATIB_REMOTE_COMPILE recorded."""
+    monkeypatch.setenv("PALLAS_AXON_REMOTE_COMPILE", "1")
+    monkeypatch.delenv("KATIB_REMOTE_COMPILE", raising=False)
+    monkeypatch.setattr(_common, "_local_compile_probe", lambda: False)
+
+    def boom(*a, **k):  # pragma: no cover - must not be reached
+        raise AssertionError("re-exec attempted despite mismatch verdict")
+
+    monkeypatch.setattr(os, "execve", boom)
+    _common.ensure_local_compile()
+    assert os.environ["KATIB_REMOTE_COMPILE"] == "1"
+
+
+def test_ensure_local_compile_reexecs_when_local_ok(cache_path, monkeypatch):
+    monkeypatch.setenv("PALLAS_AXON_REMOTE_COMPILE", "1")
+    monkeypatch.delenv("KATIB_REMOTE_COMPILE", raising=False)
+    monkeypatch.setattr(_common, "_local_compile_probe", lambda: True)
+    called = {}
+
+    def fake_execve(exe, argv, env):
+        called["env"] = dict(env)
+
+    monkeypatch.setattr(os, "execve", fake_execve)
+    _common.ensure_local_compile()
+    assert called["env"]["PALLAS_AXON_REMOTE_COMPILE"] == "0"
+
+
+def test_explicit_opt_in_skips_probe(monkeypatch):
+    monkeypatch.setenv("KATIB_REMOTE_COMPILE", "1")
+
+    def boom():  # pragma: no cover - must not be reached
+        raise AssertionError("probe ran despite explicit opt-in")
+
+    monkeypatch.setattr(_common, "_local_compile_probe", boom)
+    _common.ensure_local_compile()  # returns without probing or re-exec
