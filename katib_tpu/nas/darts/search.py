@@ -73,6 +73,7 @@ def run_darts_search(
     native_prefetch: bool | None = None,
     checkpoint_dir: str | None = None,
     remat: bool = True,
+    device_data: bool | None = None,
 ) -> dict[str, Any]:
     """Run the bilevel architecture search; returns genotype + final metrics.
 
@@ -81,6 +82,20 @@ def run_darts_search(
     the search resumes from the latest snapshot on restart — a long run on
     a preemptible/flaky chip loses at most one epoch (the reference trial
     image restarts its 50-epoch search from scratch, ``run_trial.py:148``).
+
+    ``device_data``: ship the training splits to device memory ONCE and run
+    each epoch as a single ``lax.scan`` whose body gathers its batch
+    on-device from per-epoch permutation indices.  Per step the host then
+    sends two index vectors (~KB) instead of two image batches (~MB), and
+    per epoch there is ONE dispatch instead of one per step — on a
+    relay-tunneled chip the per-step transfer+dispatch was measured at
+    ~0.73 s against a 5.8 ms compute step (artifacts/flagship/run_log.json
+    vs bench_tpu.json).  CIFAR-scale splits are a few hundred MB, far under
+    v5e HBM.  Default (``None``): enabled for single-device runs (the mesh
+    path keeps explicit per-batch ``shard_batch`` placement); overridable
+    via ``KATIB_DEVICE_DATA``.  Batch composition per epoch is IDENTICAL to
+    the host-streamed path (same ``default_rng([seed, epoch])`` permutation
+    draw order), so resume and reproducibility semantics do not change.
     """
     net = DartsNetwork(
         primitives=tuple(primitives),
@@ -162,15 +177,56 @@ def run_darts_search(
                 resumed_best = float(meta.get("best_accuracy", 0.0))
                 resumed_elapsed = float(meta.get("elapsed_s", 0.0))
 
+    # an EXPLICIT native-prefetch request (argument or env) outranks the
+    # implicit device_data default — otherwise run_darts_search(...,
+    # native_prefetch=True) would silently run the scan path instead of
+    # the C++ loader the caller asked for
+    prefetch_requested = native_prefetch is True or parse_bool(
+        os.environ.get("KATIB_NATIVE_LOADER")
+    )
+    if device_data is None:
+        env = os.environ.get("KATIB_DEVICE_DATA")
+        device_data = (
+            mesh is None and not prefetch_requested
+            if env is None
+            else parse_bool(env)
+        )
+    # scan_steps is the true per-epoch step count (steps_per_epoch above is
+    # clamped to >=1 for the lr schedule even when the split is smaller than
+    # one batch — the streamed path then just yields zero batches)
+    scan_steps = len(x_w) // batch_size
+    device_data = device_data and mesh is None and scan_steps >= 1
+    scan_epoch = None
+    if device_data:
+        # splits live in HBM for the whole search; the epoch is one jitted
+        # scan over [steps, batch] permutation indices with on-device gather
+        xw_d, yw_d, xa_d, ya_d = (
+            jax.device_put(a) for a in (x_w, y_w, x_a, y_a)
+        )
+
+        def _epoch(state, xw, yw, xa, ya, w_ix, a_ix):
+            def body(s, ix):
+                wi, ai = ix
+                s, m = search_step(s, (xw[wi], yw[wi]), (xa[ai], ya[ai]))
+                return s, m["train_loss"]
+
+            return jax.lax.scan(body, state, (w_ix, a_ix))
+
+        # donate the carried state: the bilevel step holds two full weight
+        # copies already — double-buffering a third across the epoch call
+        # would waste HBM
+        scan_epoch = jax.jit(_epoch, donate_argnums=(0,))
+
     # optional native prefetch: C++ worker threads gather the next shuffled
     # batch while the device runs the current bilevel step (enable with
     # native_prefetch=True or KATIB_NATIVE_LOADER=1; falls back silently
-    # when the native runtime isn't built)
+    # when the native runtime isn't built).  Moot under device_data — there
+    # is no host-side batch gather left to overlap.
     if native_prefetch is None:
         native_prefetch = os.environ.get("KATIB_NATIVE_LOADER", "") not in ("", "0")
     native_loaders = None
     loader_cache_dir = None
-    if native_prefetch:
+    if native_prefetch and not device_data:
         from katib_tpu.native import native_available
 
         if native_available():
@@ -218,39 +274,68 @@ def run_darts_search(
 
     best_acc = resumed_best
     history = list(resumed_history)
+    # the eval batch is constant across epochs — place it once instead of
+    # re-shipping ~MBs over the (possibly tunneled) host->device link per
+    # epoch
+    ne = min(len(dataset.x_test), 1024)
+    eval_batch = (dataset.x_test[:ne], dataset.y_test[:ne])
+    eval_batch = (
+        shard_batch(eval_batch, mesh)
+        if mesh is not None
+        else jax.device_put(eval_batch)
+    )
     # time base continues across restarts so elapsed_s stays monotonic
     t0 = time.perf_counter() - resumed_elapsed
     try:
         for epoch in range(start_epoch, num_epochs):
-            if native_loaders is not None:
-                w_stream = native_loaders[0].epoch()
-                a_stream = native_loaders[1].epoch()
-            else:
-                # per-epoch stream keyed on (seed, epoch): a run resumed at
-                # epoch k shuffles exactly like the uninterrupted run would
-                # have — a shared sequential rng would replay epoch 0's
-                # order after every restart
+            if scan_epoch is not None:
+                # identical draw order to the batches() path below: w's
+                # permutation first, then a's, from the same (seed, epoch)
+                # stream
                 erng = np.random.default_rng([seed, epoch])
-                w_stream = batches(x_w, y_w, batch_size, erng)
-                a_stream = batches(x_a, y_a, batch_size, erng)
-            # keep per-step losses as device futures: float()-ing inside the
-            # loop would block the host on every step and serialize the
-            # async dispatch pipeline (one device round-trip per step — on a
-            # tunneled chip that is the dominant cost); one transfer per
-            # epoch instead
-            step_losses = []
-            for wb, ab in zip(w_stream, a_stream):
-                if mesh is not None:
-                    wb, ab = shard_batch(wb, mesh), shard_batch(ab, mesh)
-                state, metrics = search_step(state, wb, ab)
-                step_losses.append(metrics["train_loss"])
-            steps = len(step_losses)
-            train_loss = float(np.sum(jax.device_get(step_losses))) if steps else 0.0
+                n_used = scan_steps * batch_size
+                w_ix = erng.permutation(len(x_w))[:n_used]
+                a_ix = erng.permutation(len(x_a))[:n_used]
+                shape = (scan_steps, batch_size)
+                state, losses = scan_epoch(
+                    state,
+                    xw_d,
+                    yw_d,
+                    xa_d,
+                    ya_d,
+                    jnp.asarray(w_ix.reshape(shape), jnp.int32),
+                    jnp.asarray(a_ix.reshape(shape), jnp.int32),
+                )
+                steps = scan_steps
+                train_loss = float(jnp.sum(losses))
+            else:
+                if native_loaders is not None:
+                    w_stream = native_loaders[0].epoch()
+                    a_stream = native_loaders[1].epoch()
+                else:
+                    # per-epoch stream keyed on (seed, epoch): a run resumed
+                    # at epoch k shuffles exactly like the uninterrupted run
+                    # would have — a shared sequential rng would replay
+                    # epoch 0's order after every restart
+                    erng = np.random.default_rng([seed, epoch])
+                    w_stream = batches(x_w, y_w, batch_size, erng)
+                    a_stream = batches(x_a, y_a, batch_size, erng)
+                # keep per-step losses as device futures: float()-ing inside
+                # the loop would block the host on every step and serialize
+                # the async dispatch pipeline (one device round-trip per
+                # step — on a tunneled chip that is the dominant cost); one
+                # transfer per epoch instead
+                step_losses = []
+                for wb, ab in zip(w_stream, a_stream):
+                    if mesh is not None:
+                        wb, ab = shard_batch(wb, mesh), shard_batch(ab, mesh)
+                    state, metrics = search_step(state, wb, ab)
+                    step_losses.append(metrics["train_loss"])
+                steps = len(step_losses)
+                train_loss = (
+                    float(np.sum(jax.device_get(step_losses))) if steps else 0.0
+                )
 
-            ne = min(len(dataset.x_test), 1024)
-            eval_batch = (dataset.x_test[:ne], dataset.y_test[:ne])
-            if mesh is not None:
-                eval_batch = shard_batch(eval_batch, mesh)
             em = evaluate((state.weights, state.alphas), eval_batch)
             val_acc = float(em["accuracy"])
             best_acc = max(best_acc, val_acc)

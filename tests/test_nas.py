@@ -483,3 +483,42 @@ class TestNativePrefetchSearch:
             )
         assert any("native prefetch unavailable" in str(w.message) for w in caught)
         assert r["genotype"] is not None
+
+
+class TestDeviceDataSearch:
+    def test_scan_epoch_matches_streamed_path(self):
+        """device_data=True (HBM-resident splits, one lax.scan dispatch per
+        epoch) must reproduce the streamed path's trajectory exactly: same
+        (seed, epoch) permutation draws => same batch composition => same
+        history. Guards the docstring claim that the fast path changes the
+        transport, not the math."""
+        from katib_tpu.models.data import synthetic_classification
+        from katib_tpu.nas.darts.architect import DartsHyper
+        from katib_tpu.nas.darts.search import run_darts_search
+
+        ds = synthetic_classification(96, 48, (12, 12, 3), 6, seed=0)
+        kw = dict(
+            num_layers=2, init_channels=4, n_nodes=2, num_epochs=2,
+            batch_size=16, hyper=DartsHyper(unrolled=False), seed=3,
+        )
+        streamed = run_darts_search(ds, device_data=False, **kw)
+        scanned = run_darts_search(ds, device_data=True, **kw)
+        for a, b in zip(streamed["history"], scanned["history"]):
+            assert a["val_accuracy"] == pytest.approx(b["val_accuracy"], abs=1e-5)
+            assert a["train_loss"] == pytest.approx(b["train_loss"], rel=1e-4)
+        assert streamed["genotype"].normal == scanned["genotype"].normal
+        assert streamed["genotype"].reduce == scanned["genotype"].reduce
+
+    def test_split_smaller_than_batch_falls_back(self):
+        """A split smaller than one batch has zero full batches; the scan
+        path must stand down (not crash on a short permutation reshape)."""
+        from katib_tpu.models.data import synthetic_classification
+        from katib_tpu.nas.darts.architect import DartsHyper
+        from katib_tpu.nas.darts.search import run_darts_search
+
+        ds = synthetic_classification(24, 16, (8, 8, 3), 4, seed=0)
+        r = run_darts_search(
+            ds, num_layers=2, init_channels=4, n_nodes=2, num_epochs=1,
+            batch_size=16, hyper=DartsHyper(unrolled=False), device_data=True,
+        )
+        assert r["genotype"] is not None
