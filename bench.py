@@ -252,13 +252,51 @@ def _aot_child() -> None:
     )
 
 
+_AOT_MEMO = os.path.join(_HERE, "artifacts", "flagship", "aot_v5e.json")
+
+
+def _aot_expected_config() -> dict:
+    """The config block the current env would produce (must match the
+    child's self-report for a memoized result to be valid)."""
+    small = parse_bool(os.environ.get("BENCH_SMALL"))
+    remat = parse_bool(os.environ.get("BENCH_REMAT"))
+    return {
+        "batch": 8 if small else 64,
+        "num_layers": 2 if small else 8,
+        "init_channels": 4 if small else 16,
+        "small_shapes": small,
+        "remat": remat,
+    }
+
+
 def _run_aot(timeout: float | None = None) -> dict | None:
     """Run the AOT compile-only child; returns its block or None.
 
     The child gets a scrubbed env: ``PALLAS_AXON_POOL_IPS`` removed so the
     sitecustomize never registers the axon plugin (nothing may touch the
     relay), plus the libtpu identity vars a deviceless topology needs.
+
+    The result is memoized in ``artifacts/flagship/aot_v5e.json``: the
+    block is pure static analysis of a fixed program, and the deviceless
+    ``lower().compile()`` path bypasses JAX's persistent executable cache,
+    so without the memo every bench invocation would re-pay the ~27 min
+    full-size compile.  The memo is keyed on the config block and the
+    jax version; ``BENCH_AOT_FRESH=1`` forces a recompile.
     """
+    if not parse_bool(os.environ.get("BENCH_AOT_FRESH")):
+        try:
+            with open(_AOT_MEMO) as f:
+                memo = json.load(f)
+            import jax as _jax
+
+            if (
+                memo.get("config") == _aot_expected_config()
+                and memo.get("jax_version") == _jax.__version__
+            ):
+                memo.setdefault("from_memo", True)
+                return memo
+        except (OSError, ValueError):
+            pass
     if timeout is None:
         # the TPU-target compile of the full bilevel program is heavy
         # (~2.5 min at SMALL shapes); give full shapes real headroom
@@ -285,9 +323,19 @@ def _run_aot(timeout: float | None = None) -> dict | None:
     for line in (out or "").splitlines():
         if line.startswith(_RESULT_TAG):
             try:
-                return json.loads(line[len(_RESULT_TAG):])
+                block = json.loads(line[len(_RESULT_TAG):])
             except json.JSONDecodeError:
+                continue
+            try:  # memoize for the next invocation (see docstring)
+                import jax as _jax
+
+                block["jax_version"] = _jax.__version__
+                os.makedirs(os.path.dirname(_AOT_MEMO), exist_ok=True)
+                with open(_AOT_MEMO, "w") as f:
+                    json.dump(block, f, indent=2)
+            except OSError:
                 pass
+            return block
     print(
         f"bench: AOT compile-only child failed rc={proc.returncode}:\n"
         + (err or "")[-2000:],
