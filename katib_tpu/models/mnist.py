@@ -87,13 +87,20 @@ def train_classifier(
     eval_batch: int = 1024,
     init_transform=None,
     on_finish=None,
+    device_data: bool | None = None,
 ) -> float:
     """Train and return final test accuracy; calls ``report(epoch, acc, loss)``
     per epoch when given (the trial metrics hook).
 
     ``init_transform(params) -> params`` warm-starts the freshly initialized
     parameters (ENAS weight sharing); ``on_finish(params)`` receives the
-    final parameters (publishing back to a shared pool)."""
+    final parameters (publishing back to a shared pool).
+
+    ``device_data`` (default on for single-device runs, ``KATIB_DEVICE_DATA``
+    overrides): train split lives in device memory for the whole run and
+    each epoch is ONE jitted ``lax.scan`` with on-device batch gather from
+    permutation indices — same transport-only optimization, same
+    batch-composition guarantee as ``nas/darts/search.py``."""
     rng = np.random.default_rng(seed)
     params = model.init(
         jax.random.PRNGKey(seed), jnp.zeros((1, *dataset.input_shape), jnp.float32)
@@ -124,37 +131,77 @@ def train_classifier(
 
         state = replicate(state, mesh)
 
+    if device_data is None:
+        import os
+
+        from katib_tpu.utils.booleans import parse_bool
+
+        env = os.environ.get("KATIB_DEVICE_DATA")
+        device_data = mesh is None if env is None else parse_bool(env)
+    scan_steps = len(dataset.x_train) // batch_size
+    scan_epoch = None
+    if device_data and mesh is None and scan_steps >= 1:
+        # split lives in HBM across the run; arrays are explicit arguments
+        # (closure-captured constants would be re-embedded per trace)
+        xd = jax.device_put(dataset.x_train)
+        yd = jax.device_put(dataset.y_train)
+
+        def _epoch(state, x, y, ix):
+            def body(s, i):
+                s, m = step(s, (x[i], y[i]))
+                return s, m["loss"]
+
+            return jax.lax.scan(body, state, ix)
+
+        scan_epoch = jax.jit(_epoch, donate_argnums=(0,))
+
+    # eval prefix is constant across epochs — build (and place) it once;
+    # under a mesh it truncates to a multiple of the data-axis size
+    # (shard_batch's divisibility contract — 397 test rows on an 8-way
+    # axis would otherwise crash after the training epochs already ran)
+    ne = min(eval_batch, len(dataset.x_test))
+    xe = dataset.x_test[:ne]
+    ye = dataset.y_test[:ne]
+    if mesh is not None:
+        from katib_tpu.parallel.mesh import DATA_AXIS, local_mesh_size
+
+        d = local_mesh_size(mesh, DATA_AXIS)
+        if ne >= d:
+            xe, ye = xe[: (ne // d) * d], ye[: (ne // d) * d]
+        elif ne > 0:  # tiny split: tile up to one row per device
+            reps = -(-d // ne)
+            xe = np.tile(xe, (reps,) + (1,) * (xe.ndim - 1))[:d]
+            ye = np.tile(ye, reps)[:d]
+        # ne == 0 shards fine (0 % d == 0) and evals to NaN
+        ebatch = shard_batch((xe, ye), mesh)
+    else:
+        ebatch = jax.device_put((xe, ye))
+
     test_acc = 0.0
     for epoch in range(epochs):
-        # device futures, one transfer per epoch — per-step float() would
-        # host-sync every step and serialize async dispatch (see
-        # nas/darts/search.py)
-        step_losses = []
-        for xb, yb in batches(dataset.x_train, dataset.y_train, batch_size, rng):
-            batch = (xb, yb) if mesh is None else shard_batch((xb, yb), mesh)
-            state, metrics = step(state, batch)
-            step_losses.append(metrics["loss"])
-        n = len(step_losses)
-        train_loss = float(np.sum(jax.device_get(step_losses))) if n else 0.0
-        # eval on a fixed prefix of the test split; under a mesh the prefix
-        # truncates to a multiple of the data-axis size (shard_batch's
-        # divisibility contract — 397 test rows on an 8-way axis would
-        # otherwise crash after the training epochs already ran)
-        ne = min(eval_batch, len(dataset.x_test))
-        xe = dataset.x_test[:ne]
-        ye = dataset.y_test[:ne]
-        if mesh is not None:
-            from katib_tpu.parallel.mesh import DATA_AXIS, local_mesh_size
-
-            d = local_mesh_size(mesh, DATA_AXIS)
-            if ne >= d:
-                xe, ye = xe[: (ne // d) * d], ye[: (ne // d) * d]
-            elif ne > 0:  # tiny split: tile up to one row per device
-                reps = -(-d // ne)
-                xe = np.tile(xe, (reps,) + (1,) * (xe.ndim - 1))[:d]
-                ye = np.tile(ye, reps)[:d]
-            # ne == 0 shards fine (0 % d == 0) and evals to NaN
-        ebatch = (xe, ye) if mesh is None else shard_batch((xe, ye), mesh)
+        if scan_epoch is not None:
+            # same rng draw as batches() below: one permutation per epoch
+            # from the same sequential generator
+            idx = rng.permutation(len(dataset.x_train))[: scan_steps * batch_size]
+            state, losses = scan_epoch(
+                state,
+                xd,
+                yd,
+                jnp.asarray(idx.reshape(scan_steps, batch_size), jnp.int32),
+            )
+            n = scan_steps
+            train_loss = float(jnp.sum(losses))
+        else:
+            # device futures, one transfer per epoch — per-step float()
+            # would host-sync every step and serialize async dispatch (see
+            # nas/darts/search.py)
+            step_losses = []
+            for xb, yb in batches(dataset.x_train, dataset.y_train, batch_size, rng):
+                batch = (xb, yb) if mesh is None else shard_batch((xb, yb), mesh)
+                state, metrics = step(state, batch)
+                step_losses.append(metrics["loss"])
+            n = len(step_losses)
+            train_loss = float(np.sum(jax.device_get(step_losses))) if n else 0.0
         em = evaluate(state.params, ebatch)
         test_acc = float(em["accuracy"])
         if report is not None:

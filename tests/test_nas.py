@@ -522,3 +522,26 @@ class TestDeviceDataSearch:
             batch_size=16, hyper=DartsHyper(unrolled=False), device_data=True,
         )
         assert r["genotype"] is not None
+
+    def test_train_classifier_scan_matches_streamed(self):
+        """The shared supervised loop (MNIST trials, DARTS augment, ENAS
+        children) gets the same device-resident scan path; trajectories
+        must match the streamed path exactly."""
+        from katib_tpu.models.data import synthetic_classification
+        from katib_tpu.models.mnist import MLP, train_classifier
+
+        ds = synthetic_classification(128, 64, (6, 6, 1), 4, seed=1)
+        hist_a, hist_b = [], []
+        kw = dict(lr=0.1, epochs=3, batch_size=32, seed=7)
+        a = train_classifier(
+            MLP(units=16), ds,
+            report=lambda **m: hist_a.append(m), device_data=False, **kw,
+        )
+        b = train_classifier(
+            MLP(units=16), ds,
+            report=lambda **m: hist_b.append(m), device_data=True, **kw,
+        )
+        assert a == pytest.approx(b, abs=1e-5)
+        for ma, mb in zip(hist_a, hist_b):
+            assert ma["accuracy"] == pytest.approx(mb["accuracy"], abs=1e-5)
+            assert ma["loss"] == pytest.approx(mb["loss"], rel=1e-4)
