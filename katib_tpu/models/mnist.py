@@ -72,6 +72,81 @@ def make_optimizer(name: str, lr: float, momentum: float = 0.9):
     return optax.sgd(lr)
 
 
+def _family_optimizer(name: str) -> optax.GradientTransformation:
+    """Optimizer with lr/momentum as RUNTIME state (inject_hyperparams).
+
+    Baking hyperparameters into the trace as Python floats means every
+    trial of an HP sweep compiles its own executable — on a TPU where the
+    full compile is minutes, a 100-trial sweep would spend hours in XLA
+    for identical programs.  Injected hyperparameters live in
+    ``opt_state.hyperparams``, so one compiled step serves every
+    (lr, momentum) assignment; the placeholder 0.0 values are overwritten
+    per trial by ``_set_hyperparams``.
+    """
+    if name == "adam":
+        return optax.inject_hyperparams(optax.adam)(learning_rate=0.0)
+    if name == "momentum":
+        return optax.inject_hyperparams(optax.sgd)(learning_rate=0.0, momentum=0.0)
+    return optax.inject_hyperparams(optax.sgd)(learning_rate=0.0)
+
+
+def _set_hyperparams(opt_state, lr: float, momentum: float):
+    """Write the trial's actual hyperparameters into an inject_hyperparams
+    state (only keys the family declares are set)."""
+    hp = dict(opt_state.hyperparams)
+    hp["learning_rate"] = jnp.asarray(lr, jnp.float32)
+    if "momentum" in hp:
+        hp["momentum"] = jnp.asarray(momentum, jnp.float32)
+    return opt_state._replace(hyperparams=hp)
+
+
+# (model, optimizer family, mesh) -> (tx, step, evaluate, scan_epoch):
+# concurrent trials of an HP sweep share ONE set of jit objects, so the
+# executable compiles once per architecture instead of once per trial.
+# flax Modules hash by field values; unhashable configs (e.g. a genotype
+# carrying lists) fall back to uncached per-call builds.
+_STEP_CACHE: dict = {}
+
+
+def _build_steps(model: nn.Module, optimizer: str, mesh):
+    def loss_fn(params, batch):
+        x, y = batch
+        return cross_entropy_loss(model.apply(params, x), y)
+
+    def metric_fn(params, batch):
+        x, y = batch
+        logits = model.apply(params, x)
+        return {
+            "accuracy": accuracy(logits, y),
+            "loss": cross_entropy_loss(logits, y),
+        }
+
+    tx = _family_optimizer(optimizer)
+    step = make_train_step(loss_fn, tx, mesh)
+    evaluate = make_eval_step(metric_fn, mesh)
+
+    def _epoch(state, x, y, ix):
+        def body(s, i):
+            s, m = step(s, (x[i], y[i]))
+            return s, m["loss"]
+
+        return jax.lax.scan(body, state, ix)
+
+    scan_epoch = jax.jit(_epoch, donate_argnums=(0,))
+    return tx, step, evaluate, scan_epoch
+
+
+def _steps_for(model: nn.Module, optimizer: str, mesh):
+    try:
+        key = (hash(model), model, optimizer, None if mesh is None else id(mesh))
+    except TypeError:
+        return _build_steps(model, optimizer, mesh)
+    built = _STEP_CACHE.get(key)
+    if built is None:
+        built = _STEP_CACHE.setdefault(key, _build_steps(model, optimizer, mesh))
+    return built
+
+
 def train_classifier(
     model: nn.Module,
     dataset: Dataset,
@@ -108,24 +183,12 @@ def train_classifier(
     if init_transform is not None:
         # warm starts (e.g. ENAS weight sharing overlays the shared pool)
         params = init_transform(params)
-    tx = make_optimizer(optimizer, lr, momentum)
+    tx, step, evaluate, cached_scan_epoch = _steps_for(model, optimizer, mesh)
     state = TrainState.create(params, tx)
-
-    def loss_fn(params, batch):
-        x, y = batch
-        logits = model.apply(params, x)
-        return cross_entropy_loss(logits, y)
-
-    def metric_fn(params, batch):
-        x, y = batch
-        logits = model.apply(params, x)
-        return {
-            "accuracy": accuracy(logits, y),
-            "loss": cross_entropy_loss(logits, y),
-        }
-
-    step = make_train_step(loss_fn, tx, mesh)
-    evaluate = make_eval_step(metric_fn, mesh)
+    # lr/momentum are runtime values inside opt_state (compile-once sweeps)
+    state = state._replace(
+        opt_state=_set_hyperparams(state.opt_state, lr, momentum)
+    )
     if mesh is not None:
         from katib_tpu.parallel.mesh import replicate
 
@@ -142,18 +205,12 @@ def train_classifier(
     scan_epoch = None
     if device_data and mesh is None and scan_steps >= 1:
         # split lives in HBM across the run; arrays are explicit arguments
-        # (closure-captured constants would be re-embedded per trace)
+        # (closure-captured constants would be re-embedded per trace), and
+        # the jitted epoch comes from the shared cache so concurrent sweep
+        # trials reuse one executable
         xd = jax.device_put(dataset.x_train)
         yd = jax.device_put(dataset.y_train)
-
-        def _epoch(state, x, y, ix):
-            def body(s, i):
-                s, m = step(s, (x[i], y[i]))
-                return s, m["loss"]
-
-            return jax.lax.scan(body, state, ix)
-
-        scan_epoch = jax.jit(_epoch, donate_argnums=(0,))
+        scan_epoch = cached_scan_epoch
 
     # eval prefix is constant across epochs — build (and place) it once;
     # under a mesh it truncates to a multiple of the data-axis size

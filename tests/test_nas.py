@@ -545,3 +545,32 @@ class TestDeviceDataSearch:
         for ma, mb in zip(hist_a, hist_b):
             assert ma["accuracy"] == pytest.approx(mb["accuracy"], abs=1e-5)
             assert ma["loss"] == pytest.approx(mb["loss"], rel=1e-4)
+
+    def test_hp_sweep_compiles_once(self):
+        """Different (lr, momentum) assignments must share one traced step:
+        hyperparameters are runtime state (inject_hyperparams), not trace
+        constants — the difference between N compiles and 1 for an N-trial
+        sweep on a chip where a compile costs minutes."""
+        from katib_tpu.models import mnist as M
+        from katib_tpu.models.data import synthetic_classification
+
+        ds = synthetic_classification(128, 64, (6, 6, 1), 4, seed=1)
+        M._STEP_CACHE.clear()
+        accs = [
+            M.train_classifier(
+                M.MLP(units=16), ds, lr=lr, momentum=0.9, epochs=3,
+                batch_size=32, optimizer="momentum", seed=7,
+            )
+            for lr in (0.1, 0.0001)
+        ]
+        # the hyperparameters really flowed in: wildly different lr must
+        # produce different trajectories (placeholder-0.0 would make them
+        # identical and learn nothing)
+        assert accs[0] != accs[1]
+        # the sane-lr arm learned (4-class chance is 0.25; the injected
+        # optimizer is bit-identical to the plain one — asserted elsewhere)
+        assert accs[0] > 0.4
+        assert len(M._STEP_CACHE) == 1  # both trials hit one cache entry
+        _tx, step, _ev, scan_epoch = next(iter(M._STEP_CACHE.values()))
+        traced = scan_epoch._cache_size() + step._cache_size()
+        assert traced == 1, f"expected exactly one trace total, got {traced}"
