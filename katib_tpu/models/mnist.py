@@ -104,8 +104,16 @@ def _set_hyperparams(opt_state, lr: float, momentum: float):
 # concurrent trials of an HP sweep share ONE set of jit objects, so the
 # executable compiles once per architecture instead of once per trial.
 # flax Modules hash by field values; unhashable configs (e.g. a genotype
-# carrying lists) fall back to uncached per-call builds.
-_STEP_CACHE: dict = {}
+# carrying lists) fall back to uncached per-call builds.  LRU-bounded:
+# an ENAS search trains hundreds of DISTINCT child architectures through
+# this loop, and an unbounded map would pin every compiled executable for
+# the life of the process.
+import threading  # noqa: E402  (module-scope cache)
+from collections import OrderedDict  # noqa: E402
+
+_STEP_CACHE: OrderedDict = OrderedDict()
+_STEP_CACHE_MAX = 32
+_STEP_CACHE_LOCK = threading.Lock()
 
 
 def _build_steps(model: nn.Module, optimizer: str, mesh):
@@ -141,9 +149,19 @@ def _steps_for(model: nn.Module, optimizer: str, mesh):
         key = (hash(model), model, optimizer, None if mesh is None else id(mesh))
     except TypeError:
         return _build_steps(model, optimizer, mesh)
-    built = _STEP_CACHE.get(key)
+    with _STEP_CACHE_LOCK:
+        built = _STEP_CACHE.get(key)
     if built is None:
-        built = _STEP_CACHE.setdefault(key, _build_steps(model, optimizer, mesh))
+        # build OUTSIDE the lock (tracing is slow); a concurrent duplicate
+        # build is harmless — setdefault keeps exactly one
+        fresh = _build_steps(model, optimizer, mesh)
+        with _STEP_CACHE_LOCK:
+            built = _STEP_CACHE.setdefault(key, fresh)
+    with _STEP_CACHE_LOCK:
+        if key in _STEP_CACHE:
+            _STEP_CACHE.move_to_end(key)
+        while len(_STEP_CACHE) > _STEP_CACHE_MAX:
+            _STEP_CACHE.popitem(last=False)
     return built
 
 

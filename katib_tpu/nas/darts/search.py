@@ -286,8 +286,17 @@ def run_darts_search(
     )
     # time base continues across restarts so elapsed_s stays monotonic
     t0 = time.perf_counter() - resumed_elapsed
+    trace_epochs = parse_bool(os.environ.get("KATIB_EPOCH_TRACE"))
+
+    def _trace(tag: str, since: float) -> float:
+        now = time.perf_counter()
+        if trace_epochs:
+            print(f"epoch-trace: {tag} {now - since:.2f}s", flush=True)
+        return now
+
     try:
         for epoch in range(start_epoch, num_epochs):
+            t_mark = time.perf_counter()
             if scan_epoch is not None:
                 # identical draw order to the batches() path below: w's
                 # permutation first, then a's, from the same (seed, epoch)
@@ -307,7 +316,9 @@ def run_darts_search(
                     jnp.asarray(a_ix.reshape(shape), jnp.int32),
                 )
                 steps = scan_steps
+                t_mark = _trace("scan-dispatch", t_mark)
                 train_loss = float(jnp.sum(losses))
+                t_mark = _trace("loss-fetch", t_mark)
             else:
                 if native_loaders is not None:
                     w_stream = native_loaders[0].epoch()
@@ -338,6 +349,7 @@ def run_darts_search(
 
             em = evaluate((state.weights, state.alphas), eval_batch)
             val_acc = float(em["accuracy"])
+            t_mark = _trace("eval", t_mark)
             best_acc = max(best_acc, val_acc)
             history.append(
                 {
@@ -354,7 +366,10 @@ def run_darts_search(
             if ckpt is not None:
                 # step index = epochs completed; restore resumes at epoch
                 # `latest` with at most one epoch of lost work
-                ckpt.save(jax.device_get(state), epoch + 1)
+                host_state = jax.device_get(state)
+                t_mark = _trace("state-download", t_mark)
+                ckpt.save(host_state, epoch + 1)
+                t_mark = _trace("ckpt-save", t_mark)
                 _write_search_meta(
                     checkpoint_dir,
                     {

@@ -87,7 +87,11 @@ def main() -> int:
         hyper=DartsHyper(unrolled=True),
         seed=0,
         report=report,
-        native_prefetch=True,  # C++ batch gather overlaps device compute
+        # HBM-resident splits + one scan dispatch per epoch: on the
+        # tunneled chip the per-step host->device batch path costs ~100x
+        # the 5.8 ms compute step (docs/performance.md); the C++ prefetch
+        # loader only hides host-side gather, not the transfer itself
+        device_data=True,
         # per-epoch Orbax snapshots: a relay drop mid-run resumes from the
         # last completed epoch instead of restarting the search
         checkpoint_dir=ckpt_dir,
