@@ -84,7 +84,10 @@ from _common import remote_compile_requested  # noqa: E402
 from katib_tpu.utils.booleans import parse_bool  # noqa: E402
 
 _SMALL = os.environ.get("BENCH_SMALL", "") not in ("", "0")
-BATCH = 8 if _SMALL else 64
+# batch is overridable for scaling studies: the supernet's convs are tiny
+# (16-64 ch on 32x32), so per-op overhead dominates at the reference's
+# batch 64 and throughput scales with batch until the MXU saturates
+BATCH = int(os.environ.get("BENCH_BATCH", "8" if _SMALL else "64"))
 NUM_LAYERS = 2 if _SMALL else 8
 INIT_CHANNELS = 4 if _SMALL else 16
 N_NODES = 2 if _SMALL else 4
@@ -261,7 +264,7 @@ def _aot_expected_config() -> dict:
     small = parse_bool(os.environ.get("BENCH_SMALL"))
     remat = parse_bool(os.environ.get("BENCH_REMAT"))
     return {
-        "batch": 8 if small else 64,
+        "batch": int(os.environ.get("BENCH_BATCH", "8" if small else "64")),
         "num_layers": 2 if small else 8,
         "init_channels": 4 if small else 16,
         "small_shapes": small,
@@ -384,10 +387,18 @@ def _child() -> None:
 
     step, state, batch, net, remat = _build_flagship(jax, jnp)
 
-    # XLA's own flop count for one step (per-device); basis for MFU
+    # XLA's own flop count for one step (per-device); basis for MFU.
+    # The jitted dispatch path is ALSO the timed path: executing the
+    # lower().compile() object directly under the axon relay returns
+    # optimistically-resolved futures — block_until_ready comes back in
+    # microseconds while the chip is still working, which once inflated
+    # this benchmark 93x (5.8 ms/step reported vs 539 ms/step measured by
+    # a host-fetch-forced probe AND by the flagship run's epoch math).
+    runner = jax.jit(step)
     flops_per_step = 0.0
+    compile_secs = 0.0
     try:
-        lowered = jax.jit(step).lower(state, batch, batch)
+        lowered = runner.lower(state, batch, batch)
         t_c0 = time.perf_counter()
         compiled = lowered.compile()
         compile_secs = time.perf_counter() - t_c0
@@ -395,15 +406,21 @@ def _child() -> None:
         if isinstance(cost, (list, tuple)):
             cost = cost[0] if cost else {}
         flops_per_step = float(cost.get("flops", 0.0)) if cost else 0.0
-        runner = compiled
     except Exception as e:  # cost analysis is backend-dependent
         print(f"bench: cost analysis unavailable ({e})", file=sys.stderr)
-        compile_secs = 0.0
-        runner = step
+
+    # a tiny reduction whose result is FETCHED to the host ends the timed
+    # section: real bytes computed on the chip cannot be faked by an
+    # eagerly-resolved future (docs/performance.md, measurement integrity)
+    @jax.jit
+    def _redsum(s):
+        return sum(
+            jnp.sum(a.astype(jnp.float32)) for a in jax.tree_util.tree_leaves(s)
+        )
 
     for _ in range(WARMUP_STEPS):
         state, metrics = runner(state, batch, batch)
-    jax.block_until_ready(state)
+    float(_redsum(metrics))  # warm the reducer too
 
     if os.environ.get("BENCH_WARM_ONLY", "") not in ("", "0"):
         print(
@@ -422,7 +439,7 @@ def _child() -> None:
     t0 = time.perf_counter()
     for _ in range(TIMED_STEPS):
         state, metrics = runner(state, batch, batch)
-    jax.block_until_ready(state)
+    float(_redsum(metrics))  # host fetch = the clock cannot stop early
     dt = time.perf_counter() - t0
 
     img_per_sec = BATCH * TIMED_STEPS / dt
