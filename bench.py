@@ -125,7 +125,10 @@ def _build_flagship(jax, jnp):
     # remat off by default: at bench shapes the supernet fits HBM without
     # recompute, and the bilevel step's 5 gradient passes make recompute
     # expensive (the reference's torch trial does no remat either);
-    # BENCH_REMAT=1 restores it for memory-constrained configs
+    # BENCH_REMAT=1 restores it for memory-constrained configs, and
+    # BENCH_REMAT_POLICY=dots selects the matmul-saveable policy (keep
+    # conv/matmul outputs, recompute only elementwise — the batch-scaling
+    # configuration)
     remat = os.environ.get("BENCH_REMAT", "") not in ("", "0")
     net = DartsNetwork(
         primitives=DEFAULT_PRIMITIVES,
@@ -133,6 +136,7 @@ def _build_flagship(jax, jnp):
         num_layers=NUM_LAYERS,
         n_nodes=N_NODES,
         num_classes=10,
+        remat_policy=os.environ.get("BENCH_REMAT_POLICY") or None,
         remat=remat,
     )
     key = jax.random.PRNGKey(0)
@@ -249,13 +253,37 @@ def _aot_child() -> None:
                     "init_channels": INIT_CHANNELS,
                     "small_shapes": _SMALL,
                     "remat": remat,
+                    **(
+                        {"remat_policy": os.environ["BENCH_REMAT_POLICY"]}
+                        if os.environ.get("BENCH_REMAT_POLICY")
+                        else {}
+                    ),
                 },
             }
         )
     )
 
 
-_AOT_MEMO = os.path.join(_HERE, "artifacts", "flagship", "aot_v5e.json")
+def _aot_memo_path(config: dict) -> str:
+    """Default config memoizes to the committed aot_v5e.json; exploration
+    configs (BENCH_BATCH / BENCH_REMAT / BENCH_REMAT_POLICY overrides) get
+    their own file so a scaling study can never clobber the artifact the
+    driver's end-of-round bench relies on for its fast path."""
+    default = {
+        "batch": 8 if config["small_shapes"] else 64,
+        "num_layers": config["num_layers"],
+        "init_channels": config["init_channels"],
+        "small_shapes": config["small_shapes"],
+        "remat": False,
+    }
+    if config == default:
+        name = "aot_v5e.json"
+    else:
+        tag = f"b{config['batch']}" + ("_remat" if config.get("remat") else "")
+        if config.get("remat_policy"):
+            tag += f"_{config['remat_policy']}"
+        name = f"aot_v5e_{tag}.json"
+    return os.path.join(_HERE, "artifacts", "flagship", name)
 
 
 def _aot_expected_config() -> dict:
@@ -263,13 +291,16 @@ def _aot_expected_config() -> dict:
     child's self-report for a memoized result to be valid)."""
     small = parse_bool(os.environ.get("BENCH_SMALL"))
     remat = parse_bool(os.environ.get("BENCH_REMAT"))
-    return {
+    cfg = {
         "batch": int(os.environ.get("BENCH_BATCH", "8" if small else "64")),
         "num_layers": 2 if small else 8,
         "init_channels": 4 if small else 16,
         "small_shapes": small,
         "remat": remat,
     }
+    if os.environ.get("BENCH_REMAT_POLICY"):
+        cfg["remat_policy"] = os.environ["BENCH_REMAT_POLICY"]
+    return cfg
 
 
 def _run_aot(timeout: float | None = None) -> dict | None:
@@ -286,9 +317,10 @@ def _run_aot(timeout: float | None = None) -> dict | None:
     full-size compile.  The memo is keyed on the config block and the
     jax version; ``BENCH_AOT_FRESH=1`` forces a recompile.
     """
+    memo_path = _aot_memo_path(_aot_expected_config())
     if not parse_bool(os.environ.get("BENCH_AOT_FRESH")):
         try:
-            with open(_AOT_MEMO) as f:
+            with open(memo_path) as f:
                 memo = json.load(f)
             import jax as _jax
 
@@ -333,8 +365,8 @@ def _run_aot(timeout: float | None = None) -> dict | None:
                 import jax as _jax
 
                 block["jax_version"] = _jax.__version__
-                os.makedirs(os.path.dirname(_AOT_MEMO), exist_ok=True)
-                with open(_AOT_MEMO, "w") as f:
+                os.makedirs(os.path.dirname(memo_path), exist_ok=True)
+                with open(memo_path, "w") as f:
                     json.dump(block, f, indent=2)
             except OSError:
                 pass
@@ -473,6 +505,11 @@ def _child() -> None:
                     "init_channels": INIT_CHANNELS,
                     "small_shapes": _SMALL,
                     "remat": remat,
+                    **(
+                        {"remat_policy": os.environ["BENCH_REMAT_POLICY"]}
+                        if os.environ.get("BENCH_REMAT_POLICY")
+                        else {}
+                    ),
                 },
             }
         )

@@ -73,6 +73,7 @@ def run_darts_search(
     native_prefetch: bool | None = None,
     checkpoint_dir: str | None = None,
     remat: bool = True,
+    remat_policy: str | None = None,
     device_data: bool | None = None,
 ) -> dict[str, Any]:
     """Run the bilevel architecture search; returns genotype + final metrics.
@@ -107,8 +108,11 @@ def run_darts_search(
         # remat trades recompute for HBM; at CIFAR shapes a single v5e
         # fits the supernet without it, and the bilevel step does 5
         # gradient passes — skipping recompute is a real speedup when
-        # memory allows (remat=False)
+        # memory allows (remat=False); remat_policy="dots" keeps
+        # conv/matmul outputs and recomputes only elementwise work —
+        # the batch-scaling configuration (model.py DartsNetwork)
         remat=remat,
+        remat_policy=remat_policy,
         # model-axis meshes need the partitioner-safe conv forms
         # (ops/depthwise.py module doc)
         safe_conv=needs_safe_conv(mesh),

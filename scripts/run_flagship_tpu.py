@@ -45,8 +45,11 @@ def main() -> int:
     n_nodes = 2 if small else 4
     # remat stays ON for the flagship: the unattended full-size bilevel
     # run must not die to HBM exhaustion; FLAGSHIP_REMAT=0 opts into the
-    # faster no-recompute step once the config is known to fit
+    # faster no-recompute step once the config is known to fit, and
+    # FLAGSHIP_REMAT_POLICY=dots selects the matmul-saveable policy
+    # (cheaper recompute; see docs/performance.md batch-scaling notes)
     remat = os.environ.get("FLAGSHIP_REMAT", "1") not in ("", "0")
+    remat_policy = os.environ.get("FLAGSHIP_REMAT_POLICY") or None
 
     from katib_tpu.models.data import load_cifar10, using_real_data
     from katib_tpu.nas.darts.architect import DartsHyper
@@ -96,6 +99,7 @@ def main() -> int:
         # last completed epoch instead of restarting the search
         checkpoint_dir=ckpt_dir,
         remat=remat,
+        remat_policy=remat_policy,
     )
     wall = time.perf_counter() - t0
     # completed: clear the snapshots so the next invocation is a fresh run
@@ -132,6 +136,7 @@ def main() -> int:
             "n_train": n_train,
             "second_order": True,
             "remat": remat,
+            "remat_policy": remat_policy,
         },
         "platform": platform,
         "real_data": using_real_data("cifar10"),

@@ -574,3 +574,38 @@ class TestDeviceDataSearch:
         _tx, step, _ev, scan_epoch = next(iter(M._STEP_CACHE.values()))
         traced = scan_epoch._cache_size() + step._cache_size()
         assert traced == 1, f"expected exactly one trace total, got {traced}"
+
+    def test_remat_policy_matches_no_remat(self):
+        """Rematerialisation must never change the math: a dots-policy
+        remat search reproduces the no-remat trajectory exactly."""
+        from katib_tpu.models.data import synthetic_classification
+        from katib_tpu.nas.darts.architect import DartsHyper
+        from katib_tpu.nas.darts.search import run_darts_search
+
+        ds = synthetic_classification(96, 48, (12, 12, 3), 6, seed=0)
+        kw = dict(
+            num_layers=2, init_channels=4, n_nodes=2, num_epochs=1,
+            batch_size=16, hyper=DartsHyper(unrolled=True), seed=3,
+        )
+        plain = run_darts_search(ds, remat=False, **kw)
+        dots = run_darts_search(ds, remat=True, remat_policy="dots", **kw)
+        assert plain["history"][0]["val_accuracy"] == pytest.approx(
+            dots["history"][0]["val_accuracy"], abs=1e-4
+        )
+        # recompute legally reorders float ops, so compare the learned
+        # alphas numerically (1 epoch leaves them near their 1e-3 init —
+        # exact genotype argmax over near-ties would be flaky)
+        for a, b in zip(plain["alphas"], dots["alphas"]):
+            assert float(abs(np.asarray(a) - np.asarray(b)).max()) < 5e-3
+
+    def test_unknown_remat_policy_rejected(self):
+        import jax
+        import jax.numpy as jnp
+
+        from katib_tpu.nas.darts.model import DartsNetwork, init_alphas
+
+        net = DartsNetwork(num_layers=2, init_channels=4, n_nodes=2,
+                           remat_policy="bogus")
+        alphas = init_alphas(2, 8, jax.random.PRNGKey(0))
+        with pytest.raises(ValueError, match="unknown remat_policy"):
+            net.init(jax.random.PRNGKey(1), jnp.zeros((1, 8, 8, 3)), alphas)
