@@ -1,0 +1,143 @@
+"""Batch-scaling study for the flagship bilevel step on the TPU.
+
+The honest batch-64 measurement (artifacts/flagship/bench_tpu.json,
+~535 ms/step, 0.56% MFU) is small-op/tile-padding-bound, so throughput
+should scale sub-linearly-in-time with batch — this harness measures how
+far.  Each configuration runs through ``bench.py`` itself (same child
+isolation, same fetch-forced timing), so a scaling point is produced by
+exactly the code the driver benches with.
+
+Safety: a batch-512 terminal-side compile crashed the pool terminal and
+wedged the grant (docs/performance.md), so every configuration must carry
+a committed deviceless-AOT block proving ``hbm_fits_v5e`` before this
+script will submit it to the chip.  Missing AOT memo => the config is
+SKIPPED with a note, never attempted.
+
+Artifacts: ``artifacts/flagship/batch_scaling.json``.
+Env knobs: SCALING_CONFIGS (comma list like ``64:none,128:dots``),
+BENCH_STEPS per point (default 5).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import REPO, write_artifact  # noqa: E402
+
+RESULT_PREFIX = '{"metric"'
+
+
+def parse_configs(raw: str) -> list[tuple[int, str | None]]:
+    out: list[tuple[int, str | None]] = []
+    for part in raw.split(","):
+        batch, _, policy = part.strip().partition(":")
+        out.append((int(batch), None if policy in ("", "none") else policy))
+    return out
+
+
+def aot_block_for(batch: int, policy: str | None) -> dict | None:
+    """The committed deviceless-AOT evidence for this config, or None."""
+    if policy is None and batch == 64:
+        name = "aot_v5e.json"
+    else:
+        tag = f"b{batch}" + ("_remat" if policy is not None else "")
+        if policy:
+            tag += f"_{policy}"
+        name = f"aot_v5e_{tag}.json"
+    try:
+        with open(os.path.join(REPO, "artifacts", "flagship", name)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def main() -> int:
+    configs = parse_configs(os.environ.get("SCALING_CONFIGS", "64:none,128:dots"))
+    steps = os.environ.get("BENCH_STEPS", "5")
+    points: list[dict] = []
+    for batch, policy in configs:
+        aot = aot_block_for(batch, policy)
+        if aot is None or not aot.get("hbm_fits_v5e"):
+            points.append(
+                {
+                    "batch": batch,
+                    "remat_policy": policy,
+                    "skipped": True,
+                    "reason": (
+                        "no committed AOT fit-proof — oversized terminal "
+                        "compiles crash the pool (docs/performance.md); "
+                        "run the deviceless AOT first"
+                        if aot is None
+                        else f"AOT says {aot['hbm_gib']} GiB > v5e HBM"
+                    ),
+                }
+            )
+            continue
+        env = dict(os.environ)
+        env.update(
+            BENCH_BATCH=str(batch),
+            BENCH_SKIP_AOT="1",
+            BENCH_NO_FALLBACK="1",
+            BENCH_RETRIES="1",
+            BENCH_STEPS=steps,
+        )
+        if policy is not None:
+            env.update(BENCH_REMAT="1", BENCH_REMAT_POLICY=policy)
+        else:
+            env.pop("BENCH_REMAT", None)
+            env.pop("BENCH_REMAT_POLICY", None)
+        print(f"scaling: batch={batch} policy={policy} ...", flush=True)
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            capture_output=True,
+            text=True,
+            env=env,
+            timeout=float(os.environ.get("SCALING_POINT_TIMEOUT", "3000")),
+        )
+        rec: dict | None = None
+        for line in (proc.stdout or "").splitlines():
+            if line.startswith(RESULT_PREFIX):
+                rec = json.loads(line)
+        if rec is None:
+            points.append(
+                {
+                    "batch": batch,
+                    "remat_policy": policy,
+                    "failed": True,
+                    "stderr_tail": (proc.stderr or "")[-500:],
+                }
+            )
+            continue
+        points.append(
+            {
+                "batch": batch,
+                "remat_policy": policy,
+                "images_per_sec": rec["value"],
+                "step_secs": rec["step_secs"],
+                "mfu": rec["mfu"],
+                "platform": rec["platform"],
+                "aot_hbm_gib": aot["hbm_gib"],
+            }
+        )
+        print(f"scaling:   -> {rec['value']} img/s ({rec['step_secs']}s/step)", flush=True)
+
+    result = {
+        "what": (
+            "flagship second-order bilevel step throughput vs batch size; "
+            "each point measured by bench.py's fetch-forced child on the "
+            "chip, submitted only with committed AOT HBM-fit proof"
+        ),
+        "points": points,
+    }
+    write_artifact("flagship", "batch_scaling.json", result)
+    print(json.dumps(result["points"]), flush=True)
+    ok = any("images_per_sec" in p for p in points)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
