@@ -1,0 +1,150 @@
+"""Per-op microbenchmark of the DARTS supernet's building blocks on-chip.
+
+The honest flagship step time (~535 ms at batch 64) is far above both the
+MFU=1 floor (3.2 ms) and any sane bandwidth estimate, so SOMETHING about
+op granularity dominates — this harness measures the supernet's atoms
+individually so optimization targets the measured cost, not a guess:
+
+- depthwise 3x3 / 5x5 (the shift-MAC-free native grouped form)
+- pointwise (1x1-as-einsum) at 16 and 64 channels
+- stateless batch_norm
+- max/avg pool
+- one full MixedOp edge and one full Cell, forward and fwd+bwd
+
+Timing discipline per docs/performance.md (measurement integrity): each
+atom runs CHAINED inside one lax.scan dispatch, inputs are bumped into
+fresh buffers, and the clock stops on a host-fetched scalar.  Atom
+programs are tiny, so terminal-side compiles are seconds, not the
+wedge-hazard class.
+
+Artifact: ``artifacts/flagship/op_microbench.json``.
+Env: OPBENCH_BATCH (64), OPBENCH_STEPS (50), OPBENCH_SMALL=1 (CPU smoke).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import setup_jax, write_artifact  # noqa: E402
+
+
+def main() -> int:
+    jax = setup_jax(compile_cache=True)
+    import jax.numpy as jnp
+
+    small = os.environ.get("OPBENCH_SMALL", "") not in ("", "0")
+    batch = int(os.environ.get("OPBENCH_BATCH", "8" if small else "64"))
+    steps = int(os.environ.get("OPBENCH_STEPS", "3" if small else "50"))
+    hw = 8 if small else 32
+    platform = jax.devices()[0].platform
+
+    from katib_tpu.nas.darts.ops import MixedOp, DEFAULT_PRIMITIVES, batch_norm
+    from katib_tpu.nas.darts.model import Cell
+    from katib_tpu.ops.depthwise import DepthwiseConv, PointwiseConv
+
+    key = jax.random.PRNGKey(0)
+
+    def timed(apply_fn, x, label):
+        """Seconds per application, one chained scan dispatch."""
+
+        @jax.jit
+        def many(x0):
+            def body(c, _):
+                out = apply_fn(c)
+                if out.shape == c.shape:
+                    # renormalized feedback: bounded values, full dependence
+                    nxt = out / (jnp.float32(1.0) + jnp.abs(out).max()).astype(
+                        out.dtype
+                    )
+                    return nxt.astype(c.dtype), None
+                # shape-changing op (e.g. a Cell concat): feed a reduced
+                # scalar back into the carry so iterations still chain
+                dep = jnp.mean(out.astype(jnp.float32)) * jnp.float32(1e-6)
+                return c + dep.astype(c.dtype), None
+            return jax.lax.scan(body, x0, None, length=steps)[0]
+
+        @jax.jit
+        def bump(x0, i):
+            return x0 + (jnp.float32(i) * 0.0).astype(x0.dtype)
+
+        @jax.jit
+        def redsum(x0):
+            return jnp.sum(x0.astype(jnp.float32))
+
+        float(redsum(many(bump(x, 1))))
+        fresh = bump(x, 2)
+        jax.block_until_ready(fresh)
+        t0 = time.perf_counter()
+        float(redsum(many(fresh)))
+        per = (time.perf_counter() - t0) / steps
+        print(f"opbench: {label}: {per*1e3:.3f} ms", flush=True)
+        return per
+
+    results: dict[str, float] = {}
+
+    def bench_module(mod, c, label, shape=None):
+        x = jax.random.normal(key, shape or (batch, hw, hw, c), jnp.bfloat16)
+        params = mod.init(jax.random.PRNGKey(1), x)
+        results[label] = timed(lambda a: mod.apply(params, a), x, label)
+
+    for c in (16, 64):
+        bench_module(DepthwiseConv(kernel=3, dtype=jnp.bfloat16), c, f"dw3_c{c}")
+        bench_module(DepthwiseConv(kernel=5, dtype=jnp.bfloat16), c, f"dw5_c{c}")
+        bench_module(PointwiseConv(c, dtype=jnp.bfloat16), c, f"pw_c{c}")
+
+    x16 = jax.random.normal(key, (batch, hw, hw, 16), jnp.bfloat16)
+    results["batch_norm_c16"] = timed(lambda a: batch_norm(a).astype(a.dtype), x16, "batch_norm_c16")
+    results["max_pool_c16"] = timed(
+        lambda a: jax.lax.reduce_window(
+            a, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 1, 1, 1), "SAME"
+        ),
+        x16,
+        "max_pool_c16",
+    )
+
+    # one full mixed-op edge (all 8 primitives + weighted sum), fwd only
+    mo = MixedOp(DEFAULT_PRIMITIVES, 16, 1, dtype=jnp.bfloat16)
+    w = jax.nn.softmax(jnp.zeros((len(DEFAULT_PRIMITIVES),)))
+    mo_params = mo.init(jax.random.PRNGKey(2), x16, w)
+    results["mixed_op_edge_c16_fwd"] = timed(
+        lambda a: mo.apply(mo_params, a, w), x16, "mixed_op_edge_c16_fwd"
+    )
+
+    # one full cell fwd and fwd+bwd (the remat/vmap unit of the supernet)
+    cell = Cell(primitives=DEFAULT_PRIMITIVES, channels=16, n_nodes=4,
+                dtype=jnp.bfloat16)
+    from katib_tpu.nas.darts.model import n_edges
+
+    cw = jax.nn.softmax(
+        jnp.zeros((n_edges(4), len(DEFAULT_PRIMITIVES))), axis=-1
+    )
+    cparams = cell.init(jax.random.PRNGKey(3), x16, x16, cw)
+    results["cell_c16_fwd"] = timed(
+        lambda a: cell.apply(cparams, a, a, cw), x16, "cell_c16_fwd"
+    )
+
+    def cell_loss(a):
+        return jnp.sum(cell.apply(cparams, a, a, cw).astype(jnp.float32))
+
+    results["cell_c16_fwd_bwd"] = timed(
+        lambda a: jax.grad(lambda q: cell_loss(q))(a), x16, "cell_c16_fwd_bwd"
+    )
+
+    out = {
+        "platform": platform,
+        "batch": batch,
+        "spatial": hw,
+        "steps": steps,
+        "ms_per_op": {k: round(v * 1e3, 4) for k, v in results.items()},
+    }
+    write_artifact("flagship", "op_microbench.json", out)
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
