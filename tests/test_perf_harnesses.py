@@ -1,0 +1,86 @@
+"""Guards of the performance harnesses (scripts/run_batch_scaling.py,
+bench.py AOT memoization) — the parts whose failure modes involve a real
+chip (terminal-crashing compiles, clobbered memo fast-paths) and so must
+be pinned without one."""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(name, rel):
+    spec = importlib.util.spec_from_file_location(name, os.path.join(REPO, rel))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def scaling():
+    return _load("rbs", "scripts/run_batch_scaling.py")
+
+
+class TestBatchScalingGuards:
+    def test_parse_configs(self, scaling):
+        assert scaling.parse_configs("64:none,128:dots, 256:dots") == [
+            (64, None),
+            (128, "dots"),
+            (256, "dots"),
+        ]
+        assert scaling.parse_configs("64") == [(64, None)]
+
+    def test_known_configs_have_committed_aot_proofs(self, scaling):
+        """The default study configs must be runnable: each carries a
+        committed deviceless-AOT block that says it fits."""
+        for batch, policy in scaling.parse_configs("64:none,128:dots"):
+            blk = scaling.aot_block_for(batch, policy)
+            assert blk is not None, (batch, policy)
+            assert blk["hbm_fits_v5e"] is True
+            assert blk["config"]["batch"] == batch
+
+    def test_unproven_config_has_no_block(self, scaling):
+        """batch-512 crashed the pool terminal once; it must never have a
+        fit-proof unless someone deliberately AOT-compiles it."""
+        assert scaling.aot_block_for(512, "dots") is None
+
+
+class TestAotMemoKeying:
+    def test_default_and_exploration_paths_differ(self, monkeypatch):
+        sys.argv = ["bench"]
+        monkeypatch.delenv("BENCH_BATCH", raising=False)
+        monkeypatch.delenv("BENCH_REMAT", raising=False)
+        monkeypatch.delenv("BENCH_REMAT_POLICY", raising=False)
+        monkeypatch.delenv("BENCH_SMALL", raising=False)
+        bench = _load("bench_memo_test", "bench.py")
+        default = bench._aot_memo_path(bench._aot_expected_config())
+        assert default.endswith("aot_v5e.json")
+
+        monkeypatch.setenv("BENCH_BATCH", "128")
+        monkeypatch.setenv("BENCH_REMAT", "1")
+        monkeypatch.setenv("BENCH_REMAT_POLICY", "dots")
+        explore = bench._aot_memo_path(bench._aot_expected_config())
+        assert explore.endswith("aot_v5e_b128_remat_dots.json")
+        assert explore != default
+
+    def test_committed_default_memo_matches_default_config(self, monkeypatch):
+        """The driver's end-of-round bench relies on this memo hit to skip
+        a ~26 min AOT recompile; a drifted config key would silently cost
+        the round that time."""
+        sys.argv = ["bench"]
+        for var in ("BENCH_BATCH", "BENCH_REMAT", "BENCH_REMAT_POLICY", "BENCH_SMALL"):
+            monkeypatch.delenv(var, raising=False)
+        bench = _load("bench_memo_test2", "bench.py")
+        cfg = bench._aot_expected_config()
+        with open(bench._aot_memo_path(cfg)) as f:
+            memo = json.load(f)
+        assert memo["config"] == cfg
+        import jax
+
+        assert memo["jax_version"] == jax.__version__
