@@ -83,7 +83,7 @@ from _common import remote_compile_requested  # noqa: E402
 
 from katib_tpu.utils.booleans import parse_bool  # noqa: E402
 
-_SMALL = os.environ.get("BENCH_SMALL", "") not in ("", "0")
+_SMALL = parse_bool(os.environ.get("BENCH_SMALL"))
 # batch is overridable for scaling studies: the supernet's convs are tiny
 # (16-64 ch on 32x32), so per-op overhead dominates at the reference's
 # batch 64 and throughput scales with batch until the MXU saturates
@@ -129,7 +129,12 @@ def _build_flagship(jax, jnp):
     # BENCH_REMAT_POLICY=dots selects the matmul-saveable policy (keep
     # conv/matmul outputs, recompute only elementwise — the batch-scaling
     # configuration)
-    remat = os.environ.get("BENCH_REMAT", "") not in ("", "0")
+    remat = parse_bool(os.environ.get("BENCH_REMAT"))
+    # BENCH_FUSED=1 evaluates the 4 depthwise-separable primitives through
+    # the fused plan (2 masked depthwise + 2 batched pointwise per mixed op
+    # instead of 6+6; nas/darts/fused.py) — the measured attack on the
+    # small-op-bound 0.56% MFU profile
+    fused = parse_bool(os.environ.get("BENCH_FUSED"))
     net = DartsNetwork(
         primitives=DEFAULT_PRIMITIVES,
         init_channels=INIT_CHANNELS,
@@ -138,6 +143,7 @@ def _build_flagship(jax, jnp):
         num_classes=10,
         remat_policy=os.environ.get("BENCH_REMAT_POLICY") or None,
         remat=remat,
+        fused_convs=fused,
     )
     key = jax.random.PRNGKey(0)
     k_init, k_alpha, k_data = jax.random.split(key, 3)
@@ -258,6 +264,11 @@ def _aot_child() -> None:
                         if os.environ.get("BENCH_REMAT_POLICY")
                         else {}
                     ),
+                    **(
+                        {"fused": True}
+                        if parse_bool(os.environ.get("BENCH_FUSED"))
+                        else {}
+                    ),
                 },
             }
         )
@@ -282,6 +293,8 @@ def _aot_memo_path(config: dict) -> str:
         tag = f"b{config['batch']}" + ("_remat" if config.get("remat") else "")
         if config.get("remat_policy"):
             tag += f"_{config['remat_policy']}"
+        if config.get("fused"):
+            tag += "_fused"
         name = f"aot_v5e_{tag}.json"
     return os.path.join(_HERE, "artifacts", "flagship", name)
 
@@ -300,6 +313,8 @@ def _aot_expected_config() -> dict:
     }
     if os.environ.get("BENCH_REMAT_POLICY"):
         cfg["remat_policy"] = os.environ["BENCH_REMAT_POLICY"]
+    if parse_bool(os.environ.get("BENCH_FUSED")):
+        cfg["fused"] = True
     return cfg
 
 
@@ -361,6 +376,18 @@ def _run_aot(timeout: float | None = None) -> dict | None:
                 block = json.loads(line[len(_RESULT_TAG):])
             except json.JSONDecodeError:
                 continue
+            if block.get("config") != _aot_expected_config():
+                # the child resolved the env differently than the parent —
+                # a memo written now would key to the wrong file and
+                # clobber a committed fit-proof; keep the result, skip
+                # the write
+                print(
+                    "bench: AOT child config "
+                    f"{block.get('config')} != expected "
+                    f"{_aot_expected_config()}; not memoizing",
+                    file=sys.stderr,
+                )
+                return block
             try:  # memoize for the next invocation (see docstring)
                 import jax as _jax
 
@@ -454,7 +481,7 @@ def _child() -> None:
         state, metrics = runner(state, batch, batch)
     float(_redsum(metrics))  # warm the reducer too
 
-    if os.environ.get("BENCH_WARM_ONLY", "") not in ("", "0"):
+    if parse_bool(os.environ.get("BENCH_WARM_ONLY")):
         print(
             _RESULT_TAG
             + json.dumps(
@@ -510,6 +537,11 @@ def _child() -> None:
                         if os.environ.get("BENCH_REMAT_POLICY")
                         else {}
                     ),
+                    **(
+                        {"fused": True}
+                        if parse_bool(os.environ.get("BENCH_FUSED"))
+                        else {}
+                    ),
                 },
             }
         )
@@ -529,7 +561,7 @@ def _run_attempt(
     # (read from child_env too so the retry loop can flip it per-attempt
     # after a libtpu-mismatch failure).
     remote = (
-        child_env.get("KATIB_REMOTE_COMPILE", "") not in ("", "0")
+        parse_bool(child_env.get("KATIB_REMOTE_COMPILE"))
         or remote_compile_requested()
     )
     child_env["PALLAS_AXON_REMOTE_COMPILE"] = "1" if remote else "0"
@@ -623,7 +655,7 @@ def main() -> None:
         elif (
             attempt < retries
             and not wedged
-            and os.environ.get("BENCH_REMAT", "") in ("", "0")
+            and not parse_bool(os.environ.get("BENCH_REMAT"))
             and "BENCH_REMAT" not in extra_env
         ):
             # the child ran but crashed — plausibly HBM exhaustion from the
@@ -644,7 +676,7 @@ def main() -> None:
         "to get exit 3 instead)",
         file=sys.stderr,
     )
-    if os.environ.get("BENCH_NO_FALLBACK", "") not in ("", "0"):
+    if parse_bool(os.environ.get("BENCH_NO_FALLBACK")):
         _emit_aot_only(aot_block, last_rc)
         sys.exit(3)
     # honest fallback: a real measurement of the same step at reduced shapes

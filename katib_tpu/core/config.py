@@ -133,16 +133,19 @@ class RuntimeConfig:
 class StoreConfig:
     """Observation-store backend selection (the DB-manager connection analog)."""
 
-    backend: str = "memory"  # memory | sqlite | native | remote
+    backend: str = "memory"  # memory | sqlite | native | remote | mysql | postgres
     path: str = "katib_observations.db"  # sqlite file
     host: str = "127.0.0.1"  # remote db-manager
     port: int = 6789
+    # external-SQL backends (reference MySQL/Postgres DB-manager,
+    # ``mysql/init.go:35``): ``user:password@host:port/dbname``
+    dsn: str = ""
 
-    _BACKENDS = ("memory", "sqlite", "native", "remote")
+    _BACKENDS = ("memory", "sqlite", "native", "remote", "mysql", "postgres")
 
     @classmethod
     def from_dict(cls, data: Mapping[str, Any]) -> "StoreConfig":
-        _check_keys("store", data, ("backend", "path", "host", "port"))
+        _check_keys("store", data, ("backend", "path", "host", "port", "dsn"))
         out = cls(**data)
         if out.backend not in cls._BACKENDS:
             raise ConfigError(
@@ -167,9 +170,69 @@ class StoreConfig:
 
                 return MemoryObservationStore()
             return NativeObservationStore()
+        if self.backend in ("mysql", "postgres"):
+            return self._make_dbapi_store()
         from katib_tpu.native.dbmanager import RemoteObservationStore
 
         return RemoteObservationStore(self.host, self.port)
+
+    def _make_dbapi_store(self):
+        """External-SQL store over the reference's observation_logs schema
+        (``store/dbapi.py``).  Drivers are imported lazily — whichever of
+        the usual DB-API modules is installed is used."""
+        from katib_tpu.store.dbapi import DbapiObservationStore
+
+        user, password, host, port, dbname = _parse_dsn(
+            self.dsn, default_port=3306 if self.backend == "mysql" else 5432
+        )
+        if self.backend == "mysql":
+            candidates = ("pymysql", "MySQLdb")
+            kwargs = dict(
+                user=user, password=password, host=host, port=port, database=dbname
+            )
+        else:
+            candidates = ("psycopg2", "pg8000")
+            # database=, not dbname=: psycopg2 accepts both spellings but
+            # pg8000's connect() only knows database=
+            kwargs = dict(
+                user=user, password=password, host=host, port=port, database=dbname
+            )
+        import importlib
+
+        last_err: Exception | None = None
+        for mod_name in candidates:
+            try:
+                mod = importlib.import_module(mod_name)
+            except ImportError as e:
+                last_err = e
+                continue
+            return DbapiObservationStore(
+                lambda: mod.connect(**kwargs), dialect=self.backend
+            )
+        raise ConfigError(
+            f"store.backend {self.backend!r} needs one of {candidates} "
+            f"installed (none importable: {last_err})"
+        )
+
+
+def _parse_dsn(
+    dsn: str, default_port: int
+) -> tuple[str, str, str, int, str]:
+    """``user[:password]@host[:port]/dbname`` -> components (the shape of
+    the reference's env-assembled MySQL DSN, ``mysql/mysql.go:40-55``)."""
+    cred, _, rest = dsn.rpartition("@")
+    user, _, password = cred.partition(":")
+    hostport, _, dbname = rest.partition("/")
+    host, _, port_s = hostport.partition(":")
+    try:
+        port = int(port_s) if port_s else default_port
+    except ValueError:
+        raise ConfigError(f"store.dsn has non-numeric port: {dsn!r}") from None
+    if not host or not dbname:
+        raise ConfigError(
+            f"store.dsn must look like user:password@host:port/dbname, got {dsn!r}"
+        )
+    return user, password, host, port, dbname
 
 
 # env-var overrides, the analog of ``consts/const.go:156-166`` /
@@ -180,6 +243,7 @@ _ENV_OVERRIDES = (
     ("KATIB_TPU_STORE_PATH", ("store", "path"), str),
     ("KATIB_TPU_DB_HOST", ("store", "host"), str),
     ("KATIB_TPU_DB_PORT", ("store", "port"), int),
+    ("KATIB_TPU_DB_DSN", ("store", "dsn"), str),
 )
 
 

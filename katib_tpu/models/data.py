@@ -165,21 +165,46 @@ def batches(
 
 
 # name -> loader dispatch shared by the NAS trials (enas/trial.py,
-# darts/search.py): one place for per-dataset split defaults and the
-# accepted-names error
-NAMED_DATASETS = ("cifar10", "digits")
+# darts/search.py) and the artifact scripts: one place for per-dataset
+# split defaults and the accepted-names error
+NAMED_DATASETS = ("cifar10", "digits", "mnist")
+
+# one flag upgrades every artifact script at once: KATIB_DATASET overrides
+# each script's default dataset, so a real-data drop (cifar10.npz in
+# KATIB_DATA_DIR) flows through flagship + hyperband + ENAS with zero code
+# changes (reference loads real CIFAR-10 at container start,
+# ``darts-cnn-cifar10/run_trial.py:100-111``)
+DATASET_ENV = "KATIB_DATASET"
+
+
+def dataset_from_env(default: str) -> str:
+    """The dataset an artifact script should use: ``KATIB_DATASET`` when
+    set, else the script's own default.  Unknown names fail here — before
+    a multi-minute run records a bogus provenance field."""
+    name = os.environ.get(DATASET_ENV) or default
+    if name not in NAMED_DATASETS:
+        raise ValueError(
+            f"{DATASET_ENV}={name!r} unknown (expected one of {NAMED_DATASETS})"
+        )
+    return name
+
+
+def is_real_data(name: str) -> bool:
+    """Whether ``name`` currently resolves to real data: digits is bundled
+    (always real); the npz-backed loaders are real iff the file exists."""
+    return True if name == "digits" else using_real_data(name)
 
 
 def load_named_dataset(
     name: str, n_train: int | None = None, n_test: int | None = None
 ) -> Dataset:
     """``"digits"`` = the bundled REAL dataset (UCI handwritten digits);
-    ``"cifar10"`` = the CIFAR-10 loader (real npz via ``KATIB_DATA_DIR``,
-    structured synthetic fallback otherwise).  Split defaults are
-    per-dataset: digits has only 1797 samples, so CIFAR-scale defaults
-    would clamp its test split to nothing."""
+    ``"cifar10"``/``"mnist"`` = npz-backed loaders (real via
+    ``KATIB_DATA_DIR``, structured synthetic fallback otherwise).  Split
+    defaults are per-dataset: digits has only 1797 samples, so CIFAR-scale
+    defaults would clamp its test split to nothing."""
     # only pass what the caller specified — the loaders' own signature
-    # defaults (digits 1400/397, cifar 8192/2048) stay the single source
+    # defaults (digits 1400/397, cifar/mnist 8192/2048) stay the single source
     kwargs = {}
     if n_train is not None:
         kwargs["n_train"] = n_train
@@ -189,6 +214,8 @@ def load_named_dataset(
         return load_digits_real(**kwargs)
     if name == "cifar10":
         return load_cifar10(**kwargs)
+    if name == "mnist":
+        return load_mnist(**kwargs)
     raise ValueError(
         f"unknown dataset {name!r} (expected one of {NAMED_DATASETS})"
     )

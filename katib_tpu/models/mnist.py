@@ -144,9 +144,21 @@ def _build_steps(model: nn.Module, optimizer: str, mesh):
     return tx, step, evaluate, scan_epoch
 
 
+def _mesh_key(mesh):
+    """Stable identity for a mesh: id() can be recycled after GC, handing a
+    new mesh another mesh's cached steps (stale shardings)."""
+    if mesh is None:
+        return None
+    return (
+        tuple(getattr(d, "id", repr(d)) for d in mesh.devices.flat),
+        tuple(mesh.axis_names),
+        mesh.devices.shape,
+    )
+
+
 def _steps_for(model: nn.Module, optimizer: str, mesh):
     try:
-        key = (hash(model), model, optimizer, None if mesh is None else id(mesh))
+        key = (hash(model), model, optimizer, _mesh_key(mesh))
     except TypeError:
         return _build_steps(model, optimizer, mesh)
     with _STEP_CACHE_LOCK:

@@ -1,0 +1,270 @@
+"""Fused evaluation of the four depthwise-separable DARTS primitives.
+
+The reference primitive set (``darts-cnn-cifar10/operations.py:18-31``)
+contains four conv primitives — separable 3x3/5x5 (two depthwise-separable
+reps each) and dilated 3x3/5x5 (one rep, dilation 2).  Evaluated naively,
+one mixed op dispatches 6 depthwise convs + 6 pointwise convs + 6 batch
+norms, every one of them tiny at search width (16-64 channels on 32x32):
+the on-chip profile of the bilevel step is per-op overhead and tile
+padding, not math (0.56% MFU measured, ``docs/performance.md``).
+
+The fused form exploits that all four branches consume the SAME input and
+that every branch's tap pattern embeds in a 9x9 window:
+
+==========================  =======  ========  =========================
+branch                      kernel   dilation  taps inside the 9x9 grid
+==========================  =======  ========  =========================
+separable_convolution_3x3   3x3      1         rows/cols {3,4,5}
+separable_convolution_5x5   5x5      1         rows/cols {2..6}
+dilated_convolution_3x3     3x3      2         rows/cols {2,4,6}
+dilated_convolution_5x5     5x5      2         rows/cols {0,2,4,6,8}
+==========================  =======  ========  =========================
+
+Stage A runs all four first reps as ONE depthwise conv with channel
+multiplier 4 (kernel ``(9,9,1,4C)``, ``feature_group_count=C``), each
+branch's natural parameters scattered into its masked positions, followed
+by ONE grouped pointwise as a batched einsum (``(4,C,C)`` weights — a
+single batched matmul instead of four C x C slivers) and a per-branch BN.
+Stage B applies the separable branches' second rep the same way: one
+masked 5x5 depthwise over the two branches' 2C channels (multiplier 1,
+``feature_group_count=2C``) + a ``(2,C,C)`` batched pointwise + BN.  Net:
+2 depthwise + 2 batched-matmul pointwise + 2 BN dispatches instead of
+6 + 6 + 6, and the input is read from HBM once instead of four times.
+
+Exactness (pinned by ``tests/test_fused_ops.py``): with SAME padding the
+masked window reproduces each branch's own padding arithmetic — for
+stride s and centered masks, output o reads input ``o*s - pad_lo + tap``,
+and the 9x9 pad ((3,4) at stride 2 on even sizes; (4,4) at stride 1)
+lands every branch on exactly the offsets its natural SAME-padded conv
+reads.  The parameters ARE the unmerged parameters (same ``(k,k,1,C)``
+shapes, same lecun-normal fan-in), so the fusion is a pure
+evaluation-plan change, not a model change.
+
+``safe=True`` (meshes with a model axis, where XLA's SPMD partitioner
+miscompiles grouped-conv filter gradients — ``ops/depthwise.py`` module
+doc) computes the same masked convs as shift-MACs over each branch's
+active taps only: elementwise ops, partitioner-safe, numerically the
+masked dense conv by construction.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# (name, kernel, dilation, has_second_rep) in fixed branch order
+BRANCH_SPECS = (
+    ("separable_convolution_3x3", 3, 1, True),
+    ("separable_convolution_5x5", 5, 1, True),
+    ("dilated_convolution_3x3", 3, 2, False),
+    ("dilated_convolution_5x5", 5, 2, False),
+)
+FUSED_PRIMITIVES = tuple(s[0] for s in BRANCH_SPECS)
+
+
+def _taps(kernel: int, dilation: int, window: int) -> list[int]:
+    """Row/col offsets of a centered k x k (dilation d) kernel inside the
+    fused window."""
+    extent = (kernel - 1) * dilation + 1
+    base = (window - extent) // 2
+    return [base + i * dilation for i in range(kernel)]
+
+
+def _same_pads(size: int, stride: int, extent: int) -> tuple[int, int]:
+    """XLA SAME padding: lo = total // 2 (stride-2/even-size gives (3,4)
+    for the 9-extent window, matching each branch's natural pads)."""
+    out = -(-size // stride)
+    total = max((out - 1) * stride + extent - size, 0)
+    return total // 2, total - total // 2
+
+
+class _MaskedDepthwise(nn.Module):
+    """Masked-window depthwise conv evaluating B branches in one dispatch.
+
+    ``specs``: ((param_name, kernel, dilation), ...), one branch per spec;
+    parameters keep the unmerged ``(k, k, 1, C)`` shape and lecun-normal
+    fan-in so checkpoints round-trip with the per-branch form.
+
+    ``shared_input=True``: input (N, H, W, C); every branch convolves the
+    same C channels (channel multiplier B).  ``shared_input=False``: input
+    (N, H, W, B, C); branch b convolves only its own slice (multiplier 1
+    over the flattened B*C channels).  Output is (N, H', W', B, C) either
+    way.
+    """
+
+    specs: tuple  # ((name, kernel, dilation), ...)
+    window: int
+    stride: int = 1
+    shared_input: bool = True
+    dtype: jnp.dtype = jnp.bfloat16
+    safe: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        import jax
+
+        nb = len(self.specs)
+        c = x.shape[-1]
+        kerns = [
+            (
+                self.param(
+                    name, nn.initializers.lecun_normal(), (k, k, 1, c), jnp.float32
+                ).astype(self.dtype),
+                k,
+                d,
+            )
+            for name, k, d in self.specs
+        ]
+        win, s = self.window, self.stride
+        if not self.safe:
+            if self.shared_input:
+                # kernel axis-3 = flatten of (C, B): grouped-conv group c
+                # (input channel c) yields output channels [c*B, (c+1)*B)
+                merged = jnp.zeros((win, win, c, nb), self.dtype)
+                for b, (kern, k, d) in enumerate(kerns):
+                    taps = _taps(k, d, win)
+                    for i, ti in enumerate(taps):
+                        for j, tj in enumerate(taps):
+                            merged = merged.at[ti, tj, :, b].set(kern[i, j, 0])
+                merged = merged.reshape(win, win, 1, c * nb)
+                out = jax.lax.conv_general_dilated(
+                    x.astype(self.dtype),
+                    merged,
+                    window_strides=(s, s),
+                    padding="SAME",
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                    feature_group_count=c,
+                )
+                out = out.reshape(*out.shape[:3], c, nb)
+                return jnp.moveaxis(out, -1, -2)  # (N, H', W', B, C)
+            # branch-sliced input: flatten (B, C) b-major; group b*C+ch is
+            # branch b's channel ch with branch b's masked kernel
+            n, h, w = x.shape[0], x.shape[1], x.shape[2]
+            merged = jnp.zeros((win, win, nb, c), self.dtype)
+            for b, (kern, k, d) in enumerate(kerns):
+                taps = _taps(k, d, win)
+                for i, ti in enumerate(taps):
+                    for j, tj in enumerate(taps):
+                        merged = merged.at[ti, tj, b, :].set(kern[i, j, 0])
+            merged = merged.reshape(win, win, 1, nb * c)
+            out = jax.lax.conv_general_dilated(
+                x.astype(self.dtype).reshape(n, h, w, nb * c),
+                merged,
+                window_strides=(s, s),
+                padding="SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                feature_group_count=nb * c,
+            )
+            return out.reshape(*out.shape[:3], nb, c)
+        # ---- shift-MAC form: each branch's ACTIVE taps only (union cost
+        # equals the unmerged safe path; pad/slice work is shared)
+        h_dim, w_dim = (1, 2)
+        h, w = x.shape[h_dim], x.shape[w_dim]
+        pad_h = _same_pads(h, s, win)
+        pad_w = _same_pads(w, s, win)
+        pad_cfg = [(0, 0)] * x.ndim
+        pad_cfg[h_dim], pad_cfg[w_dim] = pad_h, pad_w
+        xp = jnp.pad(x.astype(self.dtype), pad_cfg)
+        out_h, out_w = -(-h // s), -(-w // s)
+        branch_outs = []
+        for b, (kern, k, d) in enumerate(kerns):
+            taps = _taps(k, d, win)
+            src = xp if self.shared_input else xp[:, :, :, b, :]
+            acc = None
+            for i, ti in enumerate(taps):
+                for j, tj in enumerate(taps):
+                    tap = src[
+                        :,
+                        ti : ti + (out_h - 1) * s + 1 : s,
+                        tj : tj + (out_w - 1) * s + 1 : s,
+                        :,
+                    ]
+                    term = tap * kern[i, j, 0]
+                    acc = term if acc is None else acc + term
+            branch_outs.append(acc)
+        return jnp.stack(branch_outs, axis=-2)  # (N, H', W', B, C)
+
+
+def _grouped_pointwise(module: nn.Module, name: str, y, features: int, dtype):
+    """Per-branch 1x1 convs as ONE batched einsum: (N,H,W,B,C) x (B,C,F).
+
+    Parameter ``(B, C, F)`` stacks the unmerged ``(C, F)`` pointwise
+    kernels branch-major; lecun-normal fan-in stays C per branch."""
+    nb, c = y.shape[-2], y.shape[-1]
+    # batch_axis=0: fan-in must stay C (the unmerged per-branch fan-in),
+    # not B*C
+    kern = module.param(
+        name,
+        nn.initializers.lecun_normal(batch_axis=0),
+        (nb, c, features),
+        jnp.float32,
+    )
+    return jnp.einsum("nhwbc,bcf->nhwbf", y.astype(dtype), kern.astype(dtype))
+
+
+def _branch_norm(y: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    """Training-mode BN per (branch, channel) — identical statistics to the
+    unmerged per-branch ``ops.batch_norm`` (mean/var over N,H,W)."""
+    y32 = y.astype(jnp.float32)
+    mean = jnp.mean(y32, axis=(0, 1, 2), keepdims=True)
+    var = jnp.var(y32, axis=(0, 1, 2), keepdims=True)
+    return ((y32 - mean) * jnp.sqrt(1.0 / (var + eps))).astype(y.dtype)
+
+
+class FusedSepDil(nn.Module):
+    """All four depthwise-separable primitives of one mixed op, fused.
+
+    Returns ``{primitive_name: (N, H', W', C)}`` — numerically identical
+    (up to dtype rounding) to running ``SepConv``/``DilConv`` separately
+    on the same parameters (``tests/test_fused_ops.py`` embeds unmerged
+    kernels into the masked form and pins equality).
+    """
+
+    channels: int
+    stride: int
+    dtype: jnp.dtype = jnp.bfloat16
+    safe: bool = False
+
+    @nn.compact
+    def __call__(self, x) -> Dict[str, jnp.ndarray]:
+        c = self.channels
+        x = nn.relu(x)
+        # ---- stage A: all four first reps, one masked 9x9 multiplier-4 dw
+        y = _MaskedDepthwise(
+            specs=tuple((f"dw_{n}_0", k, d) for n, k, d, _ in BRANCH_SPECS),
+            window=9,
+            stride=self.stride,
+            shared_input=True,
+            dtype=self.dtype,
+            safe=self.safe,
+        )(x)
+        y = _grouped_pointwise(self, "pw_0", y, c, self.dtype)
+        y = _branch_norm(y)
+
+        # dilated branches are complete after one rep
+        out_dil3 = y[..., 2, :]
+        out_dil5 = y[..., 3, :]
+
+        # ---- stage B: separable branches' second rep (stride 1)
+        z = nn.relu(y[..., :2, :])
+        z = _MaskedDepthwise(
+            specs=tuple(
+                (f"dw_{n}_1", k, d) for n, k, d, second in BRANCH_SPECS if second
+            ),
+            window=5,
+            stride=1,
+            shared_input=False,
+            dtype=self.dtype,
+            safe=self.safe,
+        )(z)
+        z = _grouped_pointwise(self, "pw_1", z, c, self.dtype)
+        z = _branch_norm(z)
+
+        return {
+            "separable_convolution_3x3": z[..., 0, :],
+            "separable_convolution_5x5": z[..., 1, :],
+            "dilated_convolution_3x3": out_dil3,
+            "dilated_convolution_5x5": out_dil5,
+        }

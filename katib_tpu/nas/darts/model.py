@@ -76,6 +76,9 @@ class Cell(nn.Module):
     # partitioner-safe conv forms for meshes with a model axis
     # (ops/depthwise.py module doc)
     safe_conv: bool = False
+    # fused evaluation of the 4 depthwise-separable primitives
+    # (nas/darts/fused.py module doc)
+    fused_convs: bool = False
 
     @nn.compact
     def __call__(self, s0, s1, weights):
@@ -98,7 +101,7 @@ class Cell(nn.Module):
             # [k, N, H, W, C] states + [k, n_ops] weight rows -> [k, N, H', W', C]
             return VmappedMixedOp(
                 self.primitives, self.channels, stride, dtype=self.dtype,
-                safe=self.safe_conv,
+                safe=self.safe_conv, fused=self.fused_convs,
             )(jnp.stack(states_group), w_rows)
 
         states = [s0, s1]
@@ -182,23 +185,30 @@ class DartsNetwork(nn.Module):
     # select partitioner-safe conv forms; REQUIRED when training over a
     # mesh with a model axis > 1 (ops/depthwise.py module doc)
     safe_conv: bool = False
+    # fused evaluation of the 4 depthwise-separable primitives: 2 masked
+    # depthwise + 2 batched-pointwise dispatches per mixed op instead of
+    # 6+6 (nas/darts/fused.py); changes the parameter-tree layout, so it
+    # is a per-network choice, not a runtime toggle
+    fused_convs: bool = False
 
     @nn.compact
     def __call__(self, x, alphas: Alphas):
         w_normal = jax.nn.softmax(alphas.normal.astype(jnp.float32), axis=-1)
         w_reduce = jax.nn.softmax(alphas.reduce.astype(jnp.float32), axis=-1)
+        # validate the policy even with remat off, so a typo'd policy fails
+        # now rather than when remat is later re-enabled
+        policies = {
+            None: None,
+            "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        }
+        try:
+            policy = policies[self.remat_policy]
+        except KeyError:
+            raise ValueError(
+                f"unknown remat_policy {self.remat_policy!r}; "
+                f"expected one of {sorted(k for k in policies if k)} or None"
+            ) from None
         if self.remat:
-            policies = {
-                None: None,
-                "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-            }
-            try:
-                policy = policies[self.remat_policy]
-            except KeyError:
-                raise ValueError(
-                    f"unknown remat_policy {self.remat_policy!r}; "
-                    f"expected one of {sorted(k for k in policies if k)} or None"
-                ) from None
             cell_cls = (
                 nn.remat(Cell, policy=policy) if policy is not None else nn.remat(Cell)
             )
@@ -214,6 +224,7 @@ class DartsNetwork(nn.Module):
                 reduction_prev=reduction_prev,
                 dtype=self.dtype,
                 safe_conv=self.safe_conv,
+                fused_convs=self.fused_convs,
             )
             weights = w_reduce if reduction else w_normal
             return lambda s0, s1: cell(s0, s1, weights)

@@ -75,6 +75,7 @@ def run_darts_search(
     remat: bool = True,
     remat_policy: str | None = None,
     device_data: bool | None = None,
+    fused: bool = False,
 ) -> dict[str, Any]:
     """Run the bilevel architecture search; returns genotype + final metrics.
 
@@ -116,6 +117,9 @@ def run_darts_search(
         # model-axis meshes need the partitioner-safe conv forms
         # (ops/depthwise.py module doc)
         safe_conv=needs_safe_conv(mesh),
+        # fused mixed-op evaluation plan (nas/darts/fused.py): fewer,
+        # bigger dispatches for the small-op-bound supernet
+        fused_convs=fused,
     )
     rng = np.random.default_rng(seed)
     key = jax.random.PRNGKey(seed)

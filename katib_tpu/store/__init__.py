@@ -1,2 +1,3 @@
 from katib_tpu.store.base import MemoryObservationStore, ObservationStore  # noqa: F401
+from katib_tpu.store.dbapi import DbapiObservationStore  # noqa: F401
 from katib_tpu.store.sqlite import SqliteObservationStore  # noqa: F401

@@ -157,7 +157,10 @@ def setup_jax(
 
 
 def write_artifact(subdir: str, name: str, payload: dict) -> str:
-    out_dir = os.path.join(REPO, "artifacts", subdir)
+    # KATIB_ARTIFACTS_DIR redirects the output tree — integration tests run
+    # the real scripts without clobbering the committed artifacts/
+    root = os.environ.get("KATIB_ARTIFACTS_DIR") or os.path.join(REPO, "artifacts")
+    out_dir = os.path.join(root, subdir)
     os.makedirs(out_dir, exist_ok=True)
     path = os.path.join(out_dir, name)
     with open(path, "w") as f:

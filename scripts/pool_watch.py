@@ -1,0 +1,69 @@
+"""Poll the axon TPU pool until a device grant goes through, then exit 0.
+
+Each probe runs in a child process (a client blocked in device init holds
+no grant, so killing it is safe — bench.py's wedge-hardening rationale).
+The watcher exists so a long CPU-side work session can start on-chip
+harnesses the moment the pool recovers instead of discovering a healthy
+window hours late.
+
+Env knobs:
+  POOL_WATCH_PROBE_TIMEOUT  per-probe device-init deadline, s (default 240)
+  POOL_WATCH_INTERVAL       sleep between probes, s (default 300)
+  POOL_WATCH_MAX_HOURS      give up after this long (default 11)
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+_PROBE = """
+import jax
+d = jax.devices()
+import jax.numpy as jnp
+x = jnp.ones((128, 128), jnp.bfloat16)
+y = (x @ x).block_until_ready()
+print("POOL_OK", d[0].platform, d[0].device_kind, float(jnp.sum(y)))
+"""
+
+
+def probe(timeout: float) -> bool:
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    ok = proc.returncode == 0 and "POOL_OK" in (proc.stdout or "")
+    if ok:
+        print(proc.stdout.strip(), flush=True)
+    else:
+        tail = (proc.stderr or "")[-300:].replace("\n", " | ")
+        print(f"probe failed rc={proc.returncode}: {tail}", flush=True)
+    return ok
+
+
+def main() -> int:
+    timeout = float(os.environ.get("POOL_WATCH_PROBE_TIMEOUT", "240"))
+    interval = float(os.environ.get("POOL_WATCH_INTERVAL", "300"))
+    max_secs = float(os.environ.get("POOL_WATCH_MAX_HOURS", "11")) * 3600
+    t0 = time.time()
+    n = 0
+    while time.time() - t0 < max_secs:
+        n += 1
+        print(f"pool_watch: probe {n} at +{time.time() - t0:.0f}s", flush=True)
+        if probe(timeout):
+            print(f"pool_watch: POOL HEALTHY after {time.time() - t0:.0f}s", flush=True)
+            return 0
+        time.sleep(interval)
+    print("pool_watch: gave up", flush=True)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

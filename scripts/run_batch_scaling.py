@@ -91,13 +91,25 @@ def main() -> int:
             env.pop("BENCH_REMAT", None)
             env.pop("BENCH_REMAT_POLICY", None)
         print(f"scaling: batch={batch} policy={policy} ...", flush=True)
-        proc = subprocess.run(
-            [sys.executable, os.path.join(REPO, "bench.py")],
-            capture_output=True,
-            text=True,
-            env=env,
-            timeout=float(os.environ.get("SCALING_POINT_TIMEOUT", "3000")),
-        )
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(REPO, "bench.py")],
+                capture_output=True,
+                text=True,
+                env=env,
+                timeout=float(os.environ.get("SCALING_POINT_TIMEOUT", "3000")),
+            )
+        except subprocess.TimeoutExpired:
+            # one wedged point must not lose the points already measured
+            points.append(
+                {
+                    "batch": batch,
+                    "remat_policy": policy,
+                    "failed": True,
+                    "timeout": True,
+                }
+            )
+            continue
         rec: dict | None = None
         for line in (proc.stdout or "").splitlines():
             if line.startswith(RESULT_PREFIX):

@@ -52,12 +52,17 @@ def main() -> int:
     rounds = int(os.environ.get("ENAS_ROUNDS", "3"))
     per_round = int(os.environ.get("ENAS_PER_ROUND", "4"))
     # ENAS_DATASET=digits runs the children on the bundled REAL dataset
-    # (UCI handwritten digits) instead of the synthetic CIFAR-10 fallback
-    dataset = os.environ.get("ENAS_DATASET", "cifar10")
+    # (UCI handwritten digits) instead of the synthetic CIFAR-10 fallback;
+    # the cross-script KATIB_DATASET flag (models/data.py DATASET_ENV) is
+    # honored when ENAS_DATASET is not set, so one env var flips the
+    # flagship + hyperband + ENAS artifacts to a dropped-in real dataset
+    dataset = os.environ.get("ENAS_DATASET") or os.environ.get(
+        "KATIB_DATASET", "cifar10"
+    )
     if dataset not in ("cifar10", "digits"):
         # fail now, not after a multi-minute sweep recorded a dataset name
         # that was never actually loaded
-        print(f"ENAS_DATASET must be 'cifar10' or 'digits', got {dataset!r}",
+        print(f"ENAS dataset must be 'cifar10' or 'digits', got {dataset!r}",
               file=sys.stderr)
         return 2
 
@@ -77,8 +82,16 @@ def main() -> int:
         if share:
             ctx.params.setdefault("weight_sharing", "true")
         ctx.params.setdefault("dataset", dataset)
-        ctx.params.setdefault("n_train", "1400" if dataset == "digits" else "1024")
-        ctx.params.setdefault("n_test", "397" if dataset == "digits" else "256")
+        ctx.params.setdefault(
+            "n_train",
+            os.environ.get(
+                "ENAS_NTRAIN", "1400" if dataset == "digits" else "1024"
+            ),
+        )
+        ctx.params.setdefault(
+            "n_test",
+            os.environ.get("ENAS_NTEST", "397" if dataset == "digits" else "256"),
+        )
         # shared-pool children warm-start, so a third of the epoch budget
         # suffices for comparable rewards
         if dataset == "digits":

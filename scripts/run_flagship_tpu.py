@@ -18,7 +18,11 @@ CIFAR-10 fallback unless a real ``cifar10.npz`` is present in
 so nobody mistakes synthetic separability for CIFAR-10 accuracy.
 
 Env knobs: FLAGSHIP_EPOCHS (default 3), FLAGSHIP_BATCH (96),
-FLAGSHIP_NTRAIN (8192), FLAGSHIP_SMALL=1 (CPU smoke shapes).
+FLAGSHIP_NTRAIN (8192), FLAGSHIP_SMALL=1 (CPU smoke shapes),
+KATIB_DATASET (default cifar10 — the one flag that swaps every artifact
+script's dataset; with KATIB_DATA_DIR holding a real npz the whole run is
+real-data), FLAGSHIP_FUSED=1 (fused mixed-op evaluation plan,
+nas/darts/fused.py).
 """
 
 from __future__ import annotations
@@ -36,7 +40,9 @@ from _common import REPO, setup_jax, write_artifact  # noqa: E402
 def main() -> int:
     jax = setup_jax(compile_cache=True)
 
-    small = os.environ.get("FLAGSHIP_SMALL", "") not in ("", "0")
+    from katib_tpu.utils.booleans import parse_bool
+
+    small = parse_bool(os.environ.get("FLAGSHIP_SMALL"))
     epochs = int(os.environ.get("FLAGSHIP_EPOCHS", "1" if small else "3"))
     batch = int(os.environ.get("FLAGSHIP_BATCH", "16" if small else "96"))
     n_train = int(os.environ.get("FLAGSHIP_NTRAIN", "256" if small else "8192"))
@@ -48,19 +54,32 @@ def main() -> int:
     # faster no-recompute step once the config is known to fit, and
     # FLAGSHIP_REMAT_POLICY=dots selects the matmul-saveable policy
     # (cheaper recompute; see docs/performance.md batch-scaling notes)
-    remat = os.environ.get("FLAGSHIP_REMAT", "1") not in ("", "0")
+    remat = parse_bool(os.environ.get("FLAGSHIP_REMAT"), default=True)
     remat_policy = os.environ.get("FLAGSHIP_REMAT_POLICY") or None
 
-    from katib_tpu.models.data import load_cifar10, using_real_data
+    from katib_tpu.models.data import (
+        dataset_from_env,
+        is_real_data,
+        load_named_dataset,
+    )
     from katib_tpu.nas.darts.architect import DartsHyper
     from katib_tpu.nas.darts.search import run_darts_search
 
+    fused = parse_bool(os.environ.get("FLAGSHIP_FUSED"))
     platform = jax.devices()[0].platform
-    dataset = load_cifar10(n_train, 2048 if not small else 128)
+    ds_name = dataset_from_env("cifar10")
+    if ds_name == "digits":
+        # the 1797-row bundled dataset: CIFAR-scale split requests would
+        # clamp the test split to ~1 sample and record a meaningless
+        # accuracy as real-data evidence — use its own 1400/397 defaults
+        dataset = load_named_dataset(ds_name)
+        n_train = len(dataset.x_train)
+    else:
+        dataset = load_named_dataset(ds_name, n_train, 2048 if not small else 128)
     print(
         f"flagship: platform={platform} epochs={epochs} batch={batch} "
         f"layers={num_layers} channels={init_channels} n_train={n_train} "
-        f"real_data={using_real_data('cifar10')}",
+        f"dataset={ds_name} real_data={is_real_data(ds_name)} fused={fused}",
         flush=True,
     )
 
@@ -100,6 +119,7 @@ def main() -> int:
         checkpoint_dir=ckpt_dir,
         remat=remat,
         remat_policy=remat_policy,
+        fused=fused,
     )
     wall = time.perf_counter() - t0
     # completed: clear the snapshots so the next invocation is a fresh run
@@ -137,9 +157,11 @@ def main() -> int:
             "second_order": True,
             "remat": remat,
             "remat_policy": remat_policy,
+            "fused": fused,
         },
         "platform": platform,
-        "real_data": using_real_data("cifar10"),
+        "dataset": ds_name,
+        "real_data": is_real_data(ds_name),
         "wallclock_s": round(wall, 1),
         "epoch_secs": [round(t, 2) for t in epoch_times],
         "steady_state_images_per_sec": (
@@ -151,8 +173,8 @@ def main() -> int:
     }
     write_artifact("flagship", "run_log.json", log)
     print(json.dumps({k: log[k] for k in (
-        "platform", "real_data", "wallclock_s", "steady_state_images_per_sec",
-        "best_accuracy",
+        "platform", "dataset", "real_data", "wallclock_s",
+        "steady_state_images_per_sec", "best_accuracy",
     )}), flush=True)
     return 0
 

@@ -1,17 +1,24 @@
-"""Hyperband sweep demo at the BASELINE shape: 32 trials over an 8-device
-mesh with ``SliceAllocator`` sub-mesh leasing, each trial a real JAX
-training loop (the MNIST-analog MLP) on its leased one-device mesh.
+"""Hyperband sweep at the BASELINE shape: 32 trials over an 8-device mesh
+with ``SliceAllocator`` sub-mesh leasing, each trial a REAL model-scale
+training run — by default ``SmallCNN`` on the bundled real UCI digits, so
+``best_objective`` is a held-out accuracy, not a toy closed form.
 
 This is the committed-artifact half of VERDICT r1 item 4 (the invariants
 half lives in ``tests/test_hyperband_e2e.py``): the run writes
 ``artifacts/hyperband/sweep_summary.json`` with the driver metrics —
-trials/hour and best-objective@wallclock — plus the rung table, so the
-BASELINE scenario (`run-e2e-experiment.py:52-60` invariants at v5e-64
-scale) is demonstrable from the repo without hardware.
+trials/hour and best-objective@wallclock — plus the rung table and
+PER-TRIAL wall-clocks (the first trial on each leased mesh carries the
+XLA compile; later trials hit the jitted-step cache — the compile-once
+economics the BASELINE v5e-64 scenario depends on).
 
 Run with the virtual mesh:
   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
       python scripts/run_hyperband_sweep.py
+
+Env knobs: KATIB_DATASET (default digits — real data; cifar10/mnist go
+through the npz-or-synthetic loaders and record their provenance),
+SWEEP_MODEL (cnn|mlp), SWEEP_NTRAIN/SWEEP_NTEST, SWEEP_ELASTIC=1,
+SWEEP_PLATFORM.
 """
 
 from __future__ import annotations
@@ -41,21 +48,40 @@ def main() -> int:
         ParameterSpec,
         ParameterType,
     )
-    from katib_tpu.models.data import load_mnist, using_real_data
-    from katib_tpu.models.mnist import MLP, train_classifier
+    from katib_tpu.models.data import (
+        dataset_from_env,
+        is_real_data,
+        load_named_dataset,
+    )
+    from katib_tpu.models.mnist import MLP, SmallCNN, train_classifier
     from katib_tpu.orchestrator import Orchestrator
     from katib_tpu.parallel.distributed import ElasticSliceAllocator, SliceAllocator
     from katib_tpu.suggest.hyperband import I_LABEL, S_LABEL
 
+    from katib_tpu.utils.booleans import parse_bool
+
     # SWEEP_ELASTIC=1: rung resource also sizes each trial's sub-mesh
     # (devices_per_rung + ElasticSliceAllocator) — finalists train on
     # 8-device meshes while rung-0 screens 16 one-device trials
-    elastic = os.environ.get("SWEEP_ELASTIC", "") not in ("", "0")
+    elastic = parse_bool(os.environ.get("SWEEP_ELASTIC"))
 
-    dataset = load_mnist(
-        int(os.environ.get("SWEEP_NTRAIN", "1024")),
-        int(os.environ.get("SWEEP_NTEST", "256")),
+    ds_name = dataset_from_env("digits")
+    n_train = os.environ.get("SWEEP_NTRAIN")
+    n_test = os.environ.get("SWEEP_NTEST")
+    dataset = load_named_dataset(
+        ds_name,
+        int(n_train) if n_train else None,
+        int(n_test) if n_test else None,
     )
+    model_kind = os.environ.get("SWEEP_MODEL", "cnn")
+    models = {"cnn": SmallCNN, "mlp": MLP}
+    if model_kind not in models:
+        print(
+            f"SWEEP_MODEL must be one of {sorted(models)}, got {model_kind!r}",
+            file=sys.stderr,
+        )
+        return 2
+    make_model = models[model_kind]
     started = time.time()
     timeline: list[dict] = []
 
@@ -66,8 +92,9 @@ def main() -> int:
         def report(epoch, accuracy, loss):
             return ctx.report(step=epoch, accuracy=accuracy, loss=loss)
 
+        t0 = time.time()
         acc = train_classifier(
-            MLP(),
+            make_model(),
             dataset,
             lr=lr,
             epochs=epochs,
@@ -80,12 +107,20 @@ def main() -> int:
             {
                 "trial": ctx.trial_name,
                 "elapsed_s": round(time.time() - started, 2),
+                # per-trial wall-clock: the first trial per leased mesh
+                # carries the XLA compile, later ones hit the step cache
+                "duration_s": round(time.time() - t0, 2),
                 "accuracy": acc,
                 "epochs": epochs,
             }
         )
 
-    hb_settings = {"r_l": "16", "resource_name": "epochs", "eta": "4"}
+    # bounded-run knobs (integration tests / CI smoke): the BASELINE shape
+    # stays the default
+    r_l = int(os.environ.get("SWEEP_RL", "16"))
+    max_trials = int(os.environ.get("SWEEP_MAX_TRIALS", "32"))
+    parallel = int(os.environ.get("SWEEP_PARALLEL", "16"))
+    hb_settings = {"r_l": str(r_l), "resource_name": "epochs", "eta": "4"}
     if elastic:
         hb_settings["devices_per_rung"] = "true"
     spec = ExperimentSpec(
@@ -96,10 +131,12 @@ def main() -> int:
         ),
         parameters=[
             ParameterSpec("lr", ParameterType.DOUBLE, FeasibleSpace(min=0.001, max=0.5)),
-            ParameterSpec("epochs", ParameterType.INT, FeasibleSpace(min=1, max=16)),
+            ParameterSpec(
+                "epochs", ParameterType.INT, FeasibleSpace(min=1, max=r_l)
+            ),
         ],
-        max_trial_count=32,
-        parallel_trial_count=16,
+        max_trial_count=max_trials,
+        parallel_trial_count=parallel,
         train_fn=train,
     )
     if elastic:
@@ -130,11 +167,14 @@ def main() -> int:
             best = row["accuracy"]
             best_curve.append({"elapsed_s": row["elapsed_s"], "best_accuracy": best})
 
+    durations = sorted(r["duration_s"] for r in timeline)
     summary = {
         "experiment": exp.spec.name,
         "condition": exp.condition.value,
         "elastic_devices": elastic,
-        "real_data": using_real_data("mnist"),
+        "dataset": ds_name,
+        "model": model_kind,
+        "real_data": is_real_data(ds_name),
         "platform": jax.devices()[0].platform,
         "n_devices": len(jax.devices()),
         "trials_total": len(exp.trials),
@@ -147,6 +187,14 @@ def main() -> int:
         ),
         "rungs": dict(sorted(rungs.items())),
         "best_objective_vs_wallclock": best_curve,
+        # compile amortization evidence: max is a compile-carrying trial,
+        # median is the cached steady state
+        "per_trial_secs": {
+            "max": durations[-1] if durations else None,
+            "median": durations[len(durations) // 2] if durations else None,
+            "min": durations[0] if durations else None,
+        },
+        "per_trial_timeline": sorted(timeline, key=lambda r: r["elapsed_s"]),
     }
     if elastic:
         summary["devices_by_rung"] = dict(sorted(devices_by_rung.items()))
@@ -159,7 +207,16 @@ def main() -> int:
         "condition", "trials_total", "wallclock_s", "trials_per_hour",
         "best_objective",
     )}), flush=True)
-    return 0 if exp.succeeded_count == spec.max_trial_count else 1
+    # BASELINE shape: the e2e invariant is strict (32 trials ran, all
+    # succeeded — run-e2e-experiment.py:52-60).  With an overridden budget
+    # Hyperband may exhaust its brackets below max_trial_count (r_l bounds
+    # the bracket table), so the invariant relaxes to "everything that ran
+    # succeeded and something ran".
+    if os.environ.get("SWEEP_MAX_TRIALS") or os.environ.get("SWEEP_RL"):
+        ok = 0 < exp.succeeded_count == len(exp.trials)
+    else:
+        ok = exp.succeeded_count == spec.max_trial_count
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

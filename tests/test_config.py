@@ -70,7 +70,7 @@ class TestLoading:
 
     def test_bad_backend(self):
         with pytest.raises(ConfigError, match="backend"):
-            KatibConfig.from_dict({"store": {"backend": "mysql"}})
+            KatibConfig.from_dict({"store": {"backend": "oracle"}})
 
     def test_env_overrides(self):
         cfg = KatibConfig.load(
@@ -90,7 +90,7 @@ class TestLoading:
 
     def test_env_override_bad_backend(self):
         with pytest.raises(ConfigError, match="backend"):
-            KatibConfig.load(env={"KATIB_TPU_STORE_BACKEND": "mysql"})
+            KatibConfig.load(env={"KATIB_TPU_STORE_BACKEND": "oracle"})
 
 
 class TestStoreFactory:

@@ -1,0 +1,44 @@
+"""Config surface for the external-SQL store backends."""
+
+from __future__ import annotations
+
+import pytest
+
+from katib_tpu.core.config import ConfigError, StoreConfig, _parse_dsn
+
+
+def test_dsn_parse_full():
+    assert _parse_dsn("katib:secret@db.example:3307/katib", 3306) == (
+        "katib",
+        "secret",
+        "db.example",
+        3307,
+        "katib",
+    )
+
+
+def test_dsn_parse_defaults_port():
+    assert _parse_dsn("u:p@h/katib", 5432) == ("u", "p", "h", 5432, "katib")
+
+
+@pytest.mark.parametrize("bad", ["", "nohost", "u:p@/db", "u:p@h:port/db", "u:p@h:1"])
+def test_dsn_parse_rejects(bad):
+    with pytest.raises(ConfigError):
+        _parse_dsn(bad, 3306)
+
+
+def test_store_config_accepts_sql_backends():
+    cfg = StoreConfig.from_dict(
+        {"backend": "mysql", "dsn": "u:p@h:3306/katib"}
+    )
+    assert cfg.backend == "mysql" and cfg.dsn == "u:p@h:3306/katib"
+    cfg = StoreConfig.from_dict({"backend": "postgres", "dsn": "u:p@h/katib"})
+    assert cfg.backend == "postgres"
+
+
+def test_make_store_without_driver_raises_clear_error():
+    """No MySQL driver is installed in this image — the error must say
+    which modules would satisfy the backend, not crash obscurely."""
+    cfg = StoreConfig(backend="mysql", dsn="u:p@h:3306/katib")
+    with pytest.raises(ConfigError, match="pymysql"):
+        cfg.make_store()

@@ -1,0 +1,147 @@
+"""DB-API observation store: reference-schema compatibility.
+
+Proves the adapter speaks the reference's ``observation_logs`` schema
+(``mysql/init.go:35``) through a real DB-API driver (stdlib sqlite3):
+columns, time format, text values, ORDER BY time reads, the time-window
+filter, and the skip-initialization validation path.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+
+import pytest
+
+from katib_tpu.core.types import (
+    MetricLog,
+    MetricStrategy,
+    MetricStrategyType,
+    ObjectiveSpec,
+    ObjectiveType,
+)
+from katib_tpu.store.dbapi import DbapiObservationStore
+
+
+def _store(**kw):
+    conn = sqlite3.connect(":memory:", check_same_thread=False)
+    return DbapiObservationStore(conn, dialect="sqlite", **kw), conn
+
+
+def test_report_get_delete_roundtrip():
+    store, _ = _store()
+    store.report(
+        "trial-a",
+        [
+            MetricLog(metric_name="accuracy", value=0.5, timestamp=100.0),
+            MetricLog(metric_name="accuracy", value=0.75, timestamp=200.0),
+            MetricLog(metric_name="loss", value=1.25, timestamp=150.0),
+        ],
+    )
+    got = store.get("trial-a", "accuracy")
+    assert [l.value for l in got] == [0.5, 0.75]
+    assert [l.timestamp for l in got] == [100.0, 200.0]
+    assert all(l.metric_name == "accuracy" for l in got)
+    assert len(store.get("trial-a")) == 3
+    store.delete("trial-a")
+    assert store.get("trial-a") == []
+
+
+def test_reference_schema_columns_exact():
+    """The table the adapter creates has the reference's exact columns —
+    an existing Katib DB-manager client could read these rows."""
+    store, conn = _store()
+    store.report_point("t", "m", 0.9)
+    cols = [r[1] for r in conn.execute("PRAGMA table_info(observation_logs)")]
+    assert cols == ["trial_name", "id", "time", "metric_name", "value"]
+    # value is TEXT (the reference stores strings), time a DATETIME string
+    t, v = conn.execute("SELECT time, value FROM observation_logs").fetchone()
+    assert isinstance(v, str) and float(v) == 0.9
+    # reference mysqlTimeFmt: "YYYY-MM-DD HH:MM:SS.ffffff"
+    assert len(t.split(" ")) == 2 and "." in t
+
+
+def test_rows_written_by_reference_shape_are_readable():
+    """Rows inserted the way the reference's RegisterObservationLog writes
+    them (raw SQL, text values, datetime strings) come back as MetricLogs."""
+    store, conn = _store()
+    conn.executemany(
+        "INSERT INTO observation_logs (trial_name, time, metric_name, value)"
+        " VALUES (?, ?, ?, ?)",
+        [
+            ("ext-trial", "2024-01-01 00:00:00.000000", "accuracy", "0.91"),
+            ("ext-trial", "2024-01-01 00:00:01.500000", "accuracy", "0.93"),
+            # the reference stores collector strings too (e.g. genotypes);
+            # numeric reads must skip them, not crash
+            ("ext-trial", "2024-01-01 00:00:02.000000", "genotype", "Genotype(normal=[...])"),
+        ],
+    )
+    conn.commit()
+    got = store.get("ext-trial", "accuracy")
+    assert [l.value for l in got] == [0.91, 0.93]
+    assert got[0].timestamp > 0
+    assert store.get("ext-trial", "genotype") == []
+
+
+def test_time_window_filter():
+    store, _ = _store()
+    for i in range(5):
+        store.report(
+            "t", [MetricLog(metric_name="m", value=float(i), timestamp=100.0 + i)]
+        )
+    got = store.get("t", "m", start_time=101.0, end_time=103.0)
+    assert [l.value for l in got] == [1.0, 2.0, 3.0]
+
+
+def test_reads_ordered_by_time_not_insert_order():
+    store, _ = _store()
+    store.report(
+        "t",
+        [
+            MetricLog(metric_name="m", value=2.0, timestamp=200.0),
+            MetricLog(metric_name="m", value=1.0, timestamp=100.0),
+        ],
+    )
+    assert [l.value for l in store.get("t", "m")] == [1.0, 2.0]
+
+
+def test_skip_init_validates_existing_table():
+    """init_schema=False mirrors DB_SKIP_DB_INITIALIZATION: succeed against
+    an existing table, fail clearly against an empty database."""
+    conn = sqlite3.connect(":memory:", check_same_thread=False)
+    DbapiObservationStore(conn, dialect="sqlite")  # creates the table
+    DbapiObservationStore(conn, dialect="sqlite", init_schema=False)  # validates
+    empty = sqlite3.connect(":memory:", check_same_thread=False)
+    with pytest.raises(sqlite3.OperationalError):
+        DbapiObservationStore(empty, dialect="sqlite", init_schema=False)
+
+
+def test_observation_for_strategies():
+    """The shared strategy reduction works through this backend too."""
+    store, _ = _store()
+    for i, v in enumerate([0.3, 0.9, 0.7]):
+        store.report(
+            "t", [MetricLog(metric_name="accuracy", value=v, timestamp=float(i))]
+        )
+    obj = ObjectiveSpec(
+        type=ObjectiveType.MAXIMIZE,
+        objective_metric_name="accuracy",
+        metric_strategies=(MetricStrategy("accuracy", MetricStrategyType.MAX),),
+    )
+    obs = store.observation_for("t", obj)
+    assert obs is not None
+    (m,) = [m for m in obs.metrics if m.name == "accuracy"]
+    assert m.value == 0.9 and m.latest == 0.7 and m.min == 0.3
+
+
+def test_unknown_dialect_rejected():
+    with pytest.raises(ValueError):
+        DbapiObservationStore(sqlite3.connect(":memory:"), dialect="oracle")
+
+
+def test_factory_connection():
+    store = DbapiObservationStore(
+        lambda: sqlite3.connect(":memory:", check_same_thread=False),
+        dialect="sqlite",
+    )
+    store.report_point("t", "m", 1.5)
+    assert store.get("t", "m")[0].value == 1.5

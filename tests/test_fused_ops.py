@@ -1,0 +1,245 @@
+"""Parity of the fused mixed-op evaluation plan with the unmerged ops.
+
+The fused form (``nas/darts/fused.py``) must be a pure evaluation-plan
+change: the same parameters produce the same outputs as running
+``SepConv``/``DilConv`` separately.  These tests embed unmerged kernels
+into the masked form (the parameter shapes are identical by design) and
+pin equality, for both conv formulations (dense grouped / shift-MAC) and
+both strides, then at supernet level with gradients.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from katib_tpu.nas.darts.fused import FUSED_PRIMITIVES, FusedSepDil
+from katib_tpu.nas.darts.ops import DEFAULT_PRIMITIVES, MixedOp, build_op
+
+jax.config.update("jax_enable_x64", False)
+
+
+def _unmerged_params_to_fused(unmerged: dict, axis: int = 0) -> dict:
+    """Map {primitive: SepConv/DilConv params} -> FusedSepDil params.
+
+    ``axis``: where the branch axis goes when stacking pointwise kernels —
+    0 for plain modules, 1 for ``nn.vmap``-stacked params (leading axis is
+    the edge group)."""
+    sep3 = unmerged["separable_convolution_3x3"]["params"]
+    sep5 = unmerged["separable_convolution_5x5"]["params"]
+    dil3 = unmerged["dilated_convolution_3x3"]["params"]
+    dil5 = unmerged["dilated_convolution_5x5"]["params"]
+    p = {
+        "_MaskedDepthwise_0": {
+            "dw_separable_convolution_3x3_0": sep3["DepthwiseConv_0"]["kernel"],
+            "dw_separable_convolution_5x5_0": sep5["DepthwiseConv_0"]["kernel"],
+            "dw_dilated_convolution_3x3_0": dil3["DepthwiseConv_0"]["kernel"],
+            "dw_dilated_convolution_5x5_0": dil5["DepthwiseConv_0"]["kernel"],
+        },
+        "_MaskedDepthwise_1": {
+            "dw_separable_convolution_3x3_1": sep3["DepthwiseConv_1"]["kernel"],
+            "dw_separable_convolution_5x5_1": sep5["DepthwiseConv_1"]["kernel"],
+        },
+        "pw_0": jnp.stack(
+            [
+                sep3["PointwiseConv_0"]["kernel"],
+                sep5["PointwiseConv_0"]["kernel"],
+                dil3["PointwiseConv_0"]["kernel"],
+                dil5["PointwiseConv_0"]["kernel"],
+            ],
+            axis=axis,
+        ),
+        "pw_1": jnp.stack(
+            [
+                sep3["PointwiseConv_1"]["kernel"],
+                sep5["PointwiseConv_1"]["kernel"],
+            ],
+            axis=axis,
+        ),
+    }
+    return {"params": p}
+
+
+def _build_unmerged(channels, stride, x, dtype):
+    mods, params = {}, {}
+    for i, name in enumerate(FUSED_PRIMITIVES):
+        mod = build_op(name, channels, stride, dtype=dtype)
+        params[name] = mod.init(jax.random.PRNGKey(i), x)
+        mods[name] = mod
+    return mods, params
+
+
+@pytest.mark.parametrize("stride", [1, 2])
+@pytest.mark.parametrize("safe", [False, True])
+def test_fused_matches_unmerged(stride, safe):
+    """Embedding the unmerged kernels into the masked form reproduces every
+    branch, at both strides, in both conv formulations."""
+    c, dtype = 8, jnp.float32
+    x = jax.random.normal(jax.random.PRNGKey(42), (2, 8, 8, c), jnp.float32)
+    mods, params = _build_unmerged(c, stride, x, dtype)
+    want = {name: mods[name].apply(params[name], x) for name in FUSED_PRIMITIVES}
+
+    fused = FusedSepDil(c, stride, dtype=dtype, safe=safe)
+    fused_params = _unmerged_params_to_fused(params)
+    # param tree must line up with what init would create (same shapes)
+    ref_shapes = jax.tree.map(jnp.shape, fused.init(jax.random.PRNGKey(0), x))
+    got_shapes = jax.tree.map(jnp.shape, fused_params)
+    assert ref_shapes == got_shapes
+    got = fused.apply(fused_params, x)
+
+    for name in FUSED_PRIMITIVES:
+        np.testing.assert_allclose(
+            np.asarray(got[name]),
+            np.asarray(want[name]),
+            rtol=2e-5,
+            atol=2e-5,
+            err_msg=f"{name} stride={stride} safe={safe}",
+        )
+
+
+def test_fused_dense_matches_safe():
+    """The masked dense grouped conv and the shift-MAC form are the same
+    function (same params, same outputs)."""
+    c = 8
+    x = jax.random.normal(jax.random.PRNGKey(7), (2, 8, 8, c), jnp.float32)
+    params = FusedSepDil(c, 1, dtype=jnp.float32, safe=False).init(
+        jax.random.PRNGKey(0), x
+    )
+    dense = FusedSepDil(c, 1, dtype=jnp.float32, safe=False).apply(params, x)
+    shift = FusedSepDil(c, 1, dtype=jnp.float32, safe=True).apply(params, x)
+    for name in FUSED_PRIMITIVES:
+        np.testing.assert_allclose(
+            np.asarray(dense[name]), np.asarray(shift[name]), rtol=2e-5, atol=2e-5
+        )
+
+
+@pytest.mark.parametrize("stride", [1, 2])
+def test_mixed_op_fused_same_function(stride):
+    """MixedOp(fused=True) with mapped params == MixedOp(fused=False):
+    the full mixed-op contraction (all 8 primitives + softmax weights)."""
+    c, dtype = 8, jnp.float32
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 8, 8, c), jnp.float32)
+    weights = jax.nn.softmax(
+        jax.random.normal(jax.random.PRNGKey(4), (len(DEFAULT_PRIMITIVES),))
+    )
+    plain = MixedOp(DEFAULT_PRIMITIVES, c, stride, dtype=dtype, fused=False)
+    plain_params = plain.init(jax.random.PRNGKey(0), x, weights)
+    want = plain.apply(plain_params, x, weights)
+
+    fused = MixedOp(DEFAULT_PRIMITIVES, c, stride, dtype=dtype, fused=True)
+    fused_params = fused.init(jax.random.PRNGKey(0), x, weights)
+
+    # map the unmerged conv-primitive params into the fused submodule; the
+    # non-conv primitives (pool BN-less, skip/factorized-reduce) keep their
+    # own module names in both layouts
+    p = dict(plain_params["params"])
+    conv_mods = {}
+    # plain MixedOp names submodules SepConv_0, SepConv_1, DilConv_0, DilConv_1
+    conv_mods["separable_convolution_3x3"] = {"params": p.pop("SepConv_0")}
+    conv_mods["separable_convolution_5x5"] = {"params": p.pop("SepConv_1")}
+    conv_mods["dilated_convolution_3x3"] = {"params": p.pop("DilConv_0")}
+    conv_mods["dilated_convolution_5x5"] = {"params": p.pop("DilConv_1")}
+    mapped = dict(fused_params["params"])
+    assert "FusedSepDil_0" in mapped
+    mapped["FusedSepDil_0"] = _unmerged_params_to_fused(conv_mods)["params"]
+    # remaining (non-conv) modules must exist identically in both layouts
+    for k, v in p.items():
+        assert k in mapped, f"missing non-conv module {k} in fused layout"
+        mapped[k] = v
+    got = fused.apply({"params": mapped}, x, weights)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_fused_supernet_runs_and_grads():
+    """A small fused supernet runs forward and yields finite gradients for
+    both weights and alphas (the bilevel step's requirement)."""
+    from katib_tpu.nas.darts.model import DartsNetwork, init_alphas
+
+    net = DartsNetwork(
+        primitives=DEFAULT_PRIMITIVES,
+        init_channels=4,
+        num_layers=2,
+        n_nodes=2,
+        num_classes=10,
+        remat=False,
+        fused_convs=True,
+        dtype=jnp.float32,
+    )
+    key = jax.random.PRNGKey(0)
+    alphas = init_alphas(2, len(DEFAULT_PRIMITIVES), key)
+    x = jax.random.normal(key, (2, 16, 16, 3), jnp.float32)
+    y = jnp.array([1, 3])
+    params = net.init(key, x, alphas)
+
+    def loss(w, a):
+        logits = net.apply(w, x, a)
+        return -jnp.mean(
+            jax.nn.log_softmax(logits)[jnp.arange(x.shape[0]), y]
+        )
+
+    val, (gw, ga) = jax.value_and_grad(loss, argnums=(0, 1))(params, alphas)
+    assert np.isfinite(float(val))
+    leaves = jax.tree_util.tree_leaves((gw, ga))
+    assert all(np.all(np.isfinite(np.asarray(g))) for g in leaves)
+    assert any(float(jnp.max(jnp.abs(g))) > 0 for g in leaves)
+
+
+def test_fused_supernet_matches_unfused_loss():
+    """Same init RNG, mapped params: the fused supernet computes the same
+    loss as the unfused one (evaluation plan, not model change)."""
+    from katib_tpu.nas.darts.model import DartsNetwork, init_alphas
+
+    kwargs = dict(
+        primitives=DEFAULT_PRIMITIVES,
+        init_channels=4,
+        num_layers=1,
+        n_nodes=2,
+        num_classes=10,
+        remat=False,
+        dtype=jnp.float32,
+    )
+    key = jax.random.PRNGKey(0)
+    alphas = init_alphas(2, len(DEFAULT_PRIMITIVES), key)
+    x = jax.random.normal(key, (2, 16, 16, 3), jnp.float32)
+
+    plain = DartsNetwork(fused_convs=False, **kwargs)
+    fused = DartsNetwork(fused_convs=True, **kwargs)
+    plain_params = plain.init(key, x, alphas)
+    fused_params = fused.init(key, x, alphas)
+
+    def remap(tree):
+        """Walk the plain tree; wherever a vmapped MixedOp's params live,
+        rebuild the fused layout from stacked SepConv/DilConv params."""
+        if not isinstance(tree, dict):
+            return tree
+        if "SepConv_0" in tree:
+            conv_mods = {
+                "separable_convolution_3x3": {"params": tree["SepConv_0"]},
+                "separable_convolution_5x5": {"params": tree["SepConv_1"]},
+                "dilated_convolution_3x3": {"params": tree["DilConv_0"]},
+                "dilated_convolution_5x5": {"params": tree["DilConv_1"]},
+            }
+            out = {
+                k: v
+                for k, v in tree.items()
+                if k not in ("SepConv_0", "SepConv_1", "DilConv_0", "DilConv_1")
+            }
+            out["FusedSepDil_0"] = _unmerged_params_to_fused(conv_mods, axis=1)[
+                "params"
+            ]
+            return out
+        return {k: remap(v) for k, v in tree.items()}
+
+    mapped = remap(plain_params)
+    shapes_want = jax.tree.map(jnp.shape, fused_params)
+    shapes_got = jax.tree.map(jnp.shape, mapped)
+    assert shapes_want == shapes_got
+    out_plain = plain.apply(plain_params, x, alphas)
+    out_fused = fused.apply(mapped, x, alphas)
+    np.testing.assert_allclose(
+        np.asarray(out_fused), np.asarray(out_plain), rtol=5e-5, atol=5e-5
+    )
