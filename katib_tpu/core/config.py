@@ -185,18 +185,16 @@ class StoreConfig:
         user, password, host, port, dbname = _parse_dsn(
             self.dsn, default_port=3306 if self.backend == "mysql" else 5432
         )
-        if self.backend == "mysql":
-            candidates = ("pymysql", "MySQLdb")
-            kwargs = dict(
-                user=user, password=password, host=host, port=port, database=dbname
-            )
-        else:
-            candidates = ("psycopg2", "pg8000")
-            # database=, not dbname=: psycopg2 accepts both spellings but
-            # pg8000's connect() only knows database=
-            kwargs = dict(
-                user=user, password=password, host=host, port=port, database=dbname
-            )
+        candidates = (
+            ("pymysql", "MySQLdb")
+            if self.backend == "mysql"
+            else ("psycopg2", "pg8000")
+        )
+        # database=, not dbname=: every candidate accepts database= (psycopg2
+        # takes both spellings; pg8000's connect() only knows database=)
+        kwargs = dict(
+            user=user, password=password, host=host, port=port, database=dbname
+        )
         import importlib
 
         last_err: Exception | None = None

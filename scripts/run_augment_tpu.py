@@ -1,0 +1,406 @@
+"""Augment phase measured on the chip: the discovered genotype trained as
+a discrete network (DARTS paper eval protocol, the stage the reference's
+README cites — ``pkg/suggestion/v1beta1/nas/darts/README.md:3-7``).
+
+Two measurements in one run:
+
+1. **Honest step timing** (the ``docs/performance.md`` recipe: chained
+   jitted steps, fresh warmup, clock ended on a host-fetched scalar) of
+   the augment train step at the paper shape (36 channels by default) —
+   img/s + MFU from XLA's own per-step flop count.  The discrete network
+   is structurally MXU-friendlier than the supernet (2 kept ops per node,
+   no mixed-op softmax over 8 primitives), so this pins the round-3
+   hand-waving ("expected much higher than 0.56%") to a number.
+2. **A bounded accuracy run**: AUGMENT_EPOCHS of real training with
+   per-epoch held-out accuracy, so the artifact carries learning
+   evidence, not just throughput.
+
+The artifact folds the measured rate into the north-star accounting:
+search hours (measured bilevel step x 50 epochs) + augment hours
+(measured augment step x AUGMENT_ACCOUNT_EPOCHS) vs the <=4 h target.
+
+Chip safety: before anything touches the relay the script AOT-compiles
+the train step against a deviceless v5e topology and refuses configs
+that do not fit HBM (the batch-512 terminal crash rule from
+``run_batch_scaling.py``).  ``AUGMENT_AOT_ONLY=1`` stops after writing
+the fit-proof (no device grant needed — run it while the pool is
+wedged).
+
+Env knobs: AUGMENT_CHANNELS (36), AUGMENT_LAYERS (8), AUGMENT_BATCH (96),
+AUGMENT_EPOCHS (2), AUGMENT_ACCOUNT_EPOCHS (20), AUGMENT_STEPS (20,
+timed steps), AUGMENT_SMALL=1 (CPU smoke), KATIB_DATASET (cifar10).
+Artifacts: ``artifacts/flagship/augment_tpu.json`` (+ ``augment_aot.json``
+fit-proof).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import REPO, setup_jax, write_artifact  # noqa: E402
+
+V5E_HBM_BYTES = 16 * 1024**3
+PEAK_FLOPS_BF16 = 197e12
+
+
+def _load_genotype():
+    from katib_tpu.nas.darts.model import Genotype
+
+    path = os.path.join(REPO, "artifacts", "flagship", "genotype.json")
+    with open(path) as f:
+        raw = json.load(f)
+    to_gene = lambda g: tuple(  # noqa: E731
+        tuple((str(op), int(src)) for op, src in node) for node in g
+    )
+    return Genotype(normal=to_gene(raw["normal"]), reduce=to_gene(raw["reduce"]))
+
+
+def _build(jax, genotype, channels, layers, batch, num_classes, input_shape):
+    import jax.numpy as jnp
+    import optax
+
+    from katib_tpu.nas.darts.augment import GenotypeNetwork
+    from katib_tpu.parallel.train import (
+        TrainState,
+        cross_entropy_loss,
+        make_train_step,
+    )
+
+    net = GenotypeNetwork(
+        genotype=genotype,
+        init_channels=channels,
+        num_layers=layers,
+        num_classes=num_classes,
+    )
+
+    def loss_fn(params, batch_xy):
+        x, y = batch_xy
+        return cross_entropy_loss(net.apply(params, x), y)
+
+    tx = optax.sgd(0.025, momentum=0.9)
+    step = make_train_step(loss_fn, tx, mesh=None)  # already jitted
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (batch, *input_shape), jnp.float32)
+    y = jax.random.randint(jax.random.fold_in(key, 1), (batch,), 0, num_classes)
+    params = net.init(key, x[:1])
+    opt_state = tx.init(params)
+    state = TrainState(jnp.zeros((), jnp.int32), params, opt_state)
+    return net, step, state, (x, y)
+
+
+def _aot_fit_proof() -> dict:
+    """Deviceless v5e AOT compile of the augment train step: flops, HBM
+    footprint, fit verdict.  Runs in a scrubbed child so the axon plugin
+    never loads (same isolation as bench.py's AOT block)."""
+    import subprocess
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("TPU_ACCELERATOR_TYPE", "v5litepod-1")
+    env.setdefault("TPU_WORKER_HOSTNAMES", "localhost")
+    env["AUGMENT_AOT_CHILD"] = "1"
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=float(os.environ.get("AUGMENT_AOT_TIMEOUT", "2700")),
+    )
+    for line in (proc.stdout or "").splitlines():
+        if line.startswith("@@AOT@@"):
+            return json.loads(line[len("@@AOT@@"):])
+    raise RuntimeError(
+        f"augment AOT child failed rc={proc.returncode}:\n"
+        + (proc.stderr or "")[-1500:]
+    )
+
+
+def _aot_child() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir", os.path.join(REPO, ".jax_cache")
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    except Exception:
+        pass
+    from jax.experimental import topologies
+    from jax.sharding import SingleDeviceSharding
+
+    channels = int(os.environ.get("AUGMENT_CHANNELS", "36"))
+    layers = int(os.environ.get("AUGMENT_LAYERS", "8"))
+    batch = int(os.environ.get("AUGMENT_BATCH", "96"))
+    genotype = _load_genotype()
+    topo = topologies.get_topology_desc(
+        platform="tpu",
+        topology_name="v5e:1x1x1",
+        chips_per_host_bounds=(1, 1, 1),
+        num_slices=1,
+    )
+    dev = topo.devices[0]
+    net, step, state, batch_xy = _build(
+        jax, genotype, channels, layers, batch, 10, (32, 32, 3)
+    )
+    place = lambda a: jax.ShapeDtypeStruct(  # noqa: E731
+        a.shape, a.dtype, sharding=SingleDeviceSharding(dev)
+    )
+    state_s, batch_s = jax.tree.map(place, (state, batch_xy))
+    t0 = time.perf_counter()
+    compiled = jax.jit(step).lower(state_s, batch_s).compile()
+    compile_secs = time.perf_counter() - t0
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    mem = compiled.memory_analysis()
+    hbm = int(
+        mem.argument_size_in_bytes
+        + mem.output_size_in_bytes
+        + mem.temp_size_in_bytes
+        + mem.generated_code_size_in_bytes
+    )
+    print(
+        "@@AOT@@"
+        + json.dumps(
+            {
+                "target": "v5e:1x1x1 (deviceless AOT, local libtpu)",
+                "flops_per_step": float(cost.get("flops", 0.0)),
+                "hbm_bytes": hbm,
+                "hbm_gib": round(hbm / 1024**3, 3),
+                "hbm_fits_v5e": hbm < V5E_HBM_BYTES,
+                "compile_secs": round(compile_secs, 1),
+                "config": {
+                    "channels": channels,
+                    "layers": layers,
+                    "batch": batch,
+                },
+            }
+        )
+    )
+
+
+def main() -> int:
+    if os.environ.get("AUGMENT_AOT_CHILD"):
+        _aot_child()
+        return 0
+
+    from katib_tpu.utils.booleans import parse_bool
+
+    small = parse_bool(os.environ.get("AUGMENT_SMALL"))
+    channels = int(os.environ.get("AUGMENT_CHANNELS", "8" if small else "36"))
+    layers = int(os.environ.get("AUGMENT_LAYERS", "2" if small else "8"))
+    batch = int(os.environ.get("AUGMENT_BATCH", "16" if small else "96"))
+    epochs = int(os.environ.get("AUGMENT_EPOCHS", "1" if small else "2"))
+    timed_steps = int(os.environ.get("AUGMENT_STEPS", "3" if small else "20"))
+    account_epochs = int(os.environ.get("AUGMENT_ACCOUNT_EPOCHS", "20"))
+
+    # deviceless fit-proof BEFORE any relay contact (memoized on disk; the
+    # committed proof also lets a later run skip straight to the chip).
+    # Read through the same root write_artifact writes, so a
+    # KATIB_ARTIFACTS_DIR redirect cannot split the memo's read/write paths
+    art_root = os.environ.get("KATIB_ARTIFACTS_DIR") or os.path.join(
+        REPO, "artifacts"
+    )
+    proof_path = os.path.join(art_root, "flagship", "augment_aot.json")
+    proof = None
+    if not small:
+        try:
+            with open(proof_path) as f:
+                cached = json.load(f)
+            if cached.get("config") == {
+                "channels": channels,
+                "layers": layers,
+                "batch": batch,
+            }:
+                proof = cached
+        except (OSError, ValueError):
+            pass
+        if proof is None:
+            print("augment: AOT fit-proof (deviceless, no grant) ...", flush=True)
+            proof = _aot_fit_proof()
+            write_artifact("flagship", "augment_aot.json", proof)
+        if not proof["hbm_fits_v5e"]:
+            print(
+                f"augment: config does not fit v5e HBM ({proof['hbm_gib']} GiB) "
+                "— refusing to submit to the chip",
+                file=sys.stderr,
+            )
+            return 3
+        print(
+            f"augment: fit-proof ok — {proof['hbm_gib']} GiB, "
+            f"{proof['flops_per_step'] / 1e9:.1f} GFLOP/step",
+            flush=True,
+        )
+        if parse_bool(os.environ.get("AUGMENT_AOT_ONLY")):
+            return 0
+
+    jax = setup_jax(compile_cache=True)
+    import jax.numpy as jnp
+
+    from katib_tpu.models.data import (
+        dataset_from_env,
+        is_real_data,
+        load_named_dataset,
+    )
+    from katib_tpu.nas.darts.augment import train_genotype
+
+    platform = jax.devices()[0].platform
+    ds_name = dataset_from_env("cifar10")
+    dataset = load_named_dataset(
+        ds_name, 256 if small else None, 128 if small else None
+    )
+    genotype = _load_genotype()
+    print(
+        f"augment: platform={platform} channels={channels} layers={layers} "
+        f"batch={batch} dataset={ds_name} real_data={is_real_data(ds_name)}",
+        flush=True,
+    )
+
+    # ---- 1. honest step timing on synthetic tensors (pure compute rate)
+    net, step, state, batch_xy = _build(
+        jax,
+        genotype,
+        channels,
+        layers,
+        batch,
+        dataset.num_classes,
+        dataset.input_shape,
+    )
+    runner = step  # make_train_step returns the jitted dispatch path
+    flops = 0.0
+    try:
+        compiled = runner.lower(state, batch_xy).compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        flops = float(cost.get("flops", 0.0))
+    except Exception as e:
+        print(f"augment: cost analysis unavailable ({e})", file=sys.stderr)
+
+    @jax.jit
+    def _redsum(m):
+        return sum(
+            jnp.sum(a.astype(jnp.float32)) for a in jax.tree_util.tree_leaves(m)
+        )
+
+    for _ in range(2):
+        state, metrics = runner(state, batch_xy)
+    float(_redsum(metrics))
+    t0 = time.perf_counter()
+    for _ in range(timed_steps):
+        state, metrics = runner(state, batch_xy)
+    float(_redsum(metrics))  # host fetch ends the clock (integrity recipe)
+    dt = time.perf_counter() - t0
+    step_secs = dt / timed_steps
+    img_per_sec = batch / step_secs
+    mfu = (flops / step_secs) / PEAK_FLOPS_BF16 if flops else None
+    print(
+        f"augment: {step_secs * 1e3:.1f} ms/step, {img_per_sec:.1f} img/s"
+        + (f", MFU {mfu:.2%}" if mfu else ""),
+        flush=True,
+    )
+
+    # ---- 2. bounded accuracy run on the actual dataset
+    history: list[dict] = []
+    t_train0 = time.perf_counter()
+
+    def report(epoch, accuracy, loss):
+        history.append(
+            {
+                "epoch": epoch,
+                "accuracy": round(float(accuracy), 4),
+                "loss": round(float(loss), 4),
+                "elapsed_s": round(time.perf_counter() - t_train0, 1),
+            }
+        )
+        print(f"augment: epoch={epoch} acc={accuracy:.4f}", flush=True)
+        return True
+
+    final_acc = train_genotype(
+        genotype,
+        dataset,
+        init_channels=channels,
+        num_layers=layers,
+        epochs=epochs,
+        batch_size=batch,
+        report=report,
+    )
+
+    # ---- north-star accounting with MEASURED rates
+    steps_per_epoch = len(dataset.x_train) // batch
+    augment_hours = account_epochs * steps_per_epoch * step_secs / 3600.0
+    search_hours = None
+    try:
+        with open(os.path.join(REPO, "artifacts", "flagship", "bench_tpu.json")) as f:
+            bench = json.load(f)
+        if bench.get("platform") == "tpu":
+            # 50-epoch search at the measured bilevel rate, 25k images/epoch
+            # split in half for w/alpha (run_trial.py:98-111)
+            search_steps = 50 * (25000 // 2 // bench["config"]["batch"])
+            search_hours = search_steps * bench["step_secs"] / 3600.0
+    except (OSError, ValueError, KeyError):
+        pass
+
+    payload = {
+        "what": (
+            "DARTS augment phase (discrete genotype network) measured on "
+            "this platform: honest chained-step timing + a bounded real "
+            "training run"
+        ),
+        "platform": platform,
+        "dataset": ds_name,
+        "real_data": is_real_data(ds_name),
+        "config": {
+            "channels": channels,
+            "layers": layers,
+            "batch": batch,
+            "epochs_run": epochs,
+        },
+        "step_secs": round(step_secs, 5),
+        "images_per_sec": round(img_per_sec, 1),
+        "mfu": round(mfu, 5) if mfu is not None else None,
+        "flops_per_step": flops,
+        "final_accuracy": final_acc,
+        "accuracy_history": history,
+        "north_star_accounting": {
+            "search_hours_50ep_measured": (
+                round(search_hours, 2) if search_hours is not None else None
+            ),
+            "augment_epochs_assumed": account_epochs,
+            "augment_hours_measured_rate": round(augment_hours, 2),
+            "total_hours": (
+                round(search_hours + augment_hours, 2)
+                if search_hours is not None
+                else None
+            ),
+            "target_hours": 4.0,
+        },
+        "aot_fit_proof": proof,
+    }
+    write_artifact("flagship", "augment_tpu.json", payload)
+    print(
+        json.dumps(
+            {
+                k: payload[k]
+                for k in (
+                    "platform",
+                    "images_per_sec",
+                    "mfu",
+                    "final_accuracy",
+                )
+            }
+            | {"north_star": payload["north_star_accounting"]}
+        ),
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,19 +51,21 @@ def main() -> int:
 
     rounds = int(os.environ.get("ENAS_ROUNDS", "3"))
     per_round = int(os.environ.get("ENAS_PER_ROUND", "4"))
+    from katib_tpu.models.data import NAMED_DATASETS, dataset_from_env
+
     # ENAS_DATASET=digits runs the children on the bundled REAL dataset
     # (UCI handwritten digits) instead of the synthetic CIFAR-10 fallback;
     # the cross-script KATIB_DATASET flag (models/data.py DATASET_ENV) is
     # honored when ENAS_DATASET is not set, so one env var flips the
     # flagship + hyperband + ENAS artifacts to a dropped-in real dataset
-    dataset = os.environ.get("ENAS_DATASET") or os.environ.get(
-        "KATIB_DATASET", "cifar10"
-    )
-    if dataset not in ("cifar10", "digits"):
+    dataset = os.environ.get("ENAS_DATASET") or dataset_from_env("cifar10")
+    if dataset not in NAMED_DATASETS:
         # fail now, not after a multi-minute sweep recorded a dataset name
         # that was never actually loaded
-        print(f"ENAS dataset must be 'cifar10' or 'digits', got {dataset!r}",
-              file=sys.stderr)
+        print(
+            f"ENAS dataset must be one of {NAMED_DATASETS}, got {dataset!r}",
+            file=sys.stderr,
+        )
         return 2
 
     # ENAS_SHARE=1 turns on weight sharing (the ENAS paper's efficiency
@@ -191,15 +193,13 @@ def main() -> int:
         assigns = {a.name: a.value for a in exp.optimal.assignments}
         best_arch = json.loads(assigns.get("architecture", "null"))
 
-    from katib_tpu.models.data import using_real_data
+    from katib_tpu.models.data import is_real_data
 
     summary = {
         "experiment": exp.spec.name,
         "condition": exp.condition.value,
         "dataset": dataset,
-        "real_data": (
-            True if dataset == "digits" else using_real_data("cifar10")
-        ),
+        "real_data": is_real_data(dataset),
         "platform": jax.devices()[0].platform,
         "trials_total": len(exp.trials),
         "trials_succeeded": exp.succeeded_count,

@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# First-healthy-window runner: executes the queued on-chip harnesses in
+# priority order (driver bench first — VERDICT r3 item 1 — then the cheap
+# profiling harnesses, then the long flagship search, which checkpoints
+# per-epoch and resumes if the pool wedges mid-run).  Each step gets its
+# own timeout and log; a failure never blocks the next step.
+#
+# Usage:  python scripts/pool_watch.py && bash scripts/tpu_window.sh
+# Logs:   /tmp/tpu_window/<step>.log  (+ driver.log timeline)
+set -u
+cd "$(dirname "$0")/.."
+LOG=/tmp/tpu_window
+mkdir -p "$LOG"
+
+run() {
+    local t=$1 name=$2; shift 2
+    echo "=== $name start $(date -u +%F' '%T)" | tee -a "$LOG/driver.log"
+    timeout "$t" "$@" >"$LOG/$name.log" 2>&1
+    echo "=== $name rc=$? end $(date -u +%F' '%T)" | tee -a "$LOG/driver.log"
+}
+
+# 1. the driver metric, default config (AOT memoized; terminal has the
+#    program cached from round 3 — expect minutes, not the 20-min compile)
+run 5400 bench python bench.py
+
+# 2. fused-plan A/B on the same harness (BENCH_RETRIES=2 so the
+#    libtpu-mismatch auto-flip to terminal-side compile can still happen)
+run 5400 bench_fused env BENCH_FUSED=1 BENCH_NO_FALLBACK=1 BENCH_RETRIES=2 python bench.py
+
+# 3. per-op costs of the supernet atoms (~15 min)
+run 2700 op_microbench env KATIB_REMOTE_COMPILE=1 python scripts/run_op_microbench.py
+
+# 4. batch scaling at the proven configs (b64 no-remat, b128 dots)
+run 5400 batch_scaling python scripts/run_batch_scaling.py
+
+# 5. compile-once TPE sweep on real digits
+run 2700 tpe_digits env DEMO_TPU=1 python scripts/run_real_data_demo.py
+
+# 6. augment phase measured on-chip (fit-proof gate runs deviceless first)
+run 5400 augment python scripts/run_augment_tpu.py
+
+# 7. the 50-epoch flagship search (VERDICT r3 item 2); per-epoch Orbax
+#    checkpoints make this resumable, so a mid-run wedge costs one epoch
+run 14400 flagship_50ep env FLAGSHIP_EPOCHS=50 FLAGSHIP_BATCH=64 FLAGSHIP_REMAT=0 python scripts/run_flagship_tpu.py
+
+echo "=== window complete $(date -u +%F' '%T)" | tee -a "$LOG/driver.log"
