@@ -210,6 +210,12 @@ def main() -> int:
     proof_path = os.path.join(art_root, "flagship", "augment_aot.json")
     proof = None
     if not small:
+        # memo keyed on config AND jax version (the bench.py _run_aot
+        # rule): HBM footprint is compiler-version dependent, so a proof
+        # from an older jax/libtpu must not gate a newer one
+        from importlib.metadata import version as _pkg_version
+
+        jax_version = _pkg_version("jax")
         try:
             with open(proof_path) as f:
                 cached = json.load(f)
@@ -217,13 +223,14 @@ def main() -> int:
                 "channels": channels,
                 "layers": layers,
                 "batch": batch,
-            }:
+            } and cached.get("jax_version") == jax_version:
                 proof = cached
         except (OSError, ValueError):
             pass
         if proof is None:
             print("augment: AOT fit-proof (deviceless, no grant) ...", flush=True)
             proof = _aot_fit_proof()
+            proof["jax_version"] = jax_version
             write_artifact("flagship", "augment_aot.json", proof)
         if not proof["hbm_fits_v5e"]:
             print(

@@ -58,7 +58,11 @@ def main() -> int:
     # the cross-script KATIB_DATASET flag (models/data.py DATASET_ENV) is
     # honored when ENAS_DATASET is not set, so one env var flips the
     # flagship + hyperband + ENAS artifacts to a dropped-in real dataset
-    dataset = os.environ.get("ENAS_DATASET") or dataset_from_env("cifar10")
+    try:
+        dataset = os.environ.get("ENAS_DATASET") or dataset_from_env("cifar10")
+    except ValueError as e:  # bad KATIB_DATASET
+        print(f"ENAS dataset: {e}", file=sys.stderr)
+        return 2
     if dataset not in NAMED_DATASETS:
         # fail now, not after a multi-minute sweep recorded a dataset name
         # that was never actually loaded

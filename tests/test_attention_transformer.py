@@ -11,6 +11,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# interpreter-mode Pallas + sharded training loops: merge-gate tier
+pytestmark = pytest.mark.slow
+
 from katib_tpu.ops.flash_attention import (
     flash_attention,
     flash_attention_with_lse,

@@ -19,6 +19,7 @@ def ckpt_dir(tmp_path):
     return str(tmp_path / "trial-a")
 
 
+@pytest.mark.slow  # orbax round-trips dominate this class's wall-clock
 class TestTrialCheckpointer:
     def test_roundtrip_mixed_pytree(self, ckpt_dir):
         ck = TrialCheckpointer(ckpt_dir)
@@ -130,6 +131,7 @@ class TestPbtToyEndToEnd:
         assert parented, "no exploited members — truncation selection never fired"
 
 
+@pytest.mark.slow  # model-scale PBT lineage on real digits
 class TestPbtDigitsTrial:
     def test_model_state_rides_the_lineage(self, tmp_path):
         """The real-model PBT workload: a second round restores the first

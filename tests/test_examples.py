@@ -39,6 +39,7 @@ class TestShippedExamples:
         assert exp.optimal is not None
         assert exp.succeeded_count >= 1
 
+    @pytest.mark.slow
     def test_grid_example_covers_lattice(self, tmp_path):
         spec = load_experiment_yaml(
             os.path.join(REPO, "examples", "hp-tuning", "grid.yaml")

@@ -154,6 +154,7 @@ def test_mixed_op_fused_same_function(stride):
     )
 
 
+@pytest.mark.slow
 def test_fused_supernet_runs_and_grads():
     """A small fused supernet runs forward and yields finite gradients for
     both weights and alphas (the bilevel step's requirement)."""
@@ -188,6 +189,7 @@ def test_fused_supernet_runs_and_grads():
     assert any(float(jnp.max(jnp.abs(g))) > 0 for g in leaves)
 
 
+@pytest.mark.slow
 def test_fused_supernet_matches_unfused_loss():
     """Same init RNG, mapped params: the fused supernet computes the same
     loss as the unfused one (evaluation plan, not model change)."""

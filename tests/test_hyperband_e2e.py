@@ -17,6 +17,7 @@ import math
 import threading
 
 import jax
+import pytest
 
 from katib_tpu.core.types import (
     AlgorithmSpec,
@@ -33,6 +34,7 @@ from katib_tpu.parallel.distributed import SliceAllocator
 from katib_tpu.suggest.hyperband import I_LABEL, S_LABEL
 
 
+@pytest.mark.slow
 def test_hyperband_32_trial_sweep_with_slice_leasing(tmp_path):
     concurrency = {"now": 0, "peak": 0}
     seen_devices: list = []

@@ -17,6 +17,9 @@ import sys
 
 import pytest
 
+# spawns real coordinator+worker process pairs: merge-gate tier
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 WORKER = """

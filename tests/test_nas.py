@@ -1,11 +1,18 @@
 """DARTS + ENAS tests (tiny configs; CPU-backend JAX per conftest —
-the reference's CI strategy of CPU trial-image variants, SURVEY.md §4)."""
+the reference's CI strategy of CPU trial-image variants, SURVEY.md §4).
+
+Slow tier: every test here compiles real (if tiny) search/train programs —
+the file dominates the suite wall-clock, so it runs in the merge gate, not
+the PR fast lane (op-level coverage stays fast in test_fused_ops /
+test_depthwise)."""
 
 import json
 
 import jax
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow
 
 from katib_tpu.core.types import (
     AlgorithmSpec,

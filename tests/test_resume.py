@@ -324,6 +324,7 @@ class TestSuggesterStatePersistence:
         assert [p.name for p in n1] == [p.name for p in n2]
         assert [p.as_dict() for p in n1] == [p.as_dict() for p in n2]
 
+    @pytest.mark.slow
     def test_enas_state_round_trip(self, tmp_path):
         import numpy as np
 
