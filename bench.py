@@ -509,11 +509,24 @@ def _child() -> None:
     dtype_key = "bf16" if net.dtype == jnp.bfloat16 else "f32"
     peak = PEAK_FLOPS.get((gen, dtype_key), PEAK_FLOPS[("v5e", dtype_key)])
     mfu = (flops_per_step / step_secs) / peak if flops_per_step else 0.0
+    fused_note = (
+        {
+            "flops_note": (
+                "cost-analysis flops for the fused plan count the masked "
+                "grouped convs as if dense (13x the unfused program's "
+                "count, aot_v5e_b64_fused.json vs aot_v5e.json) — compare "
+                "plans by img/s, not MFU"
+            )
+        }
+        if parse_bool(os.environ.get("BENCH_FUSED"))
+        else {}
+    )
     print(
         _RESULT_TAG
         + json.dumps(
             {
                 "metric": "darts_bilevel_search_throughput",
+                **fused_note,
                 "value": round(float(img_per_sec), 2),
                 "unit": "images/sec",
                 "vs_baseline": round(float(img_per_sec) / REFERENCE_IMG_PER_SEC, 3),
