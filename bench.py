@@ -275,11 +275,13 @@ def _aot_child() -> None:
     )
 
 
-def _aot_memo_path(config: dict) -> str:
-    """Default config memoizes to the committed aot_v5e.json; exploration
-    configs (BENCH_BATCH / BENCH_REMAT / BENCH_REMAT_POLICY overrides) get
-    their own file so a scaling study can never clobber the artifact the
-    driver's end-of-round bench relies on for its fast path."""
+def _memo_path(config: dict, stem: str) -> str:
+    """Default config memoizes to the committed ``<stem>.json``;
+    exploration configs (BENCH_BATCH / BENCH_REMAT / BENCH_REMAT_POLICY /
+    BENCH_FUSED overrides) get their own file so a scaling study can never
+    clobber the artifact the driver's end-of-round bench relies on.  One
+    tag builder for BOTH the AOT and on-chip-capture memos, so the two
+    can never key differently for the same config."""
     default = {
         "batch": 8 if config["small_shapes"] else 64,
         "num_layers": config["num_layers"],
@@ -288,15 +290,19 @@ def _aot_memo_path(config: dict) -> str:
         "remat": False,
     }
     if config == default:
-        name = "aot_v5e.json"
+        name = f"{stem}.json"
     else:
         tag = f"b{config['batch']}" + ("_remat" if config.get("remat") else "")
         if config.get("remat_policy"):
             tag += f"_{config['remat_policy']}"
         if config.get("fused"):
             tag += "_fused"
-        name = f"aot_v5e_{tag}.json"
+        name = f"{stem}_{tag}.json"
     return os.path.join(_HERE, "artifacts", "flagship", name)
+
+
+def _aot_memo_path(config: dict) -> str:
+    return _memo_path(config, "aot_v5e")
 
 
 def _aot_expected_config() -> dict:
@@ -316,6 +322,64 @@ def _aot_expected_config() -> dict:
     if parse_bool(os.environ.get("BENCH_FUSED")):
         cfg["fused"] = True
     return cfg
+
+
+def _bench_memo_path(config: dict) -> str:
+    """Committed on-chip capture for this config (bench_tpu.json for the
+    driver-metric default, suffixed files for exploration configs)."""
+    return _memo_path(config, "bench_tpu")
+
+
+def _persist_tpu_result(result: dict) -> None:
+    """Write a successful full-shape on-chip measurement to the committed
+    artifact so (a) mid-round captures survive, and (b) a driver-time
+    wedge can fall back to the real number instead of a CPU stand-in
+    (round-3 verdict: the official bench row never said "tpu" because the
+    pool wedged exactly during the driver's capture window)."""
+    if (
+        result.get("platform") != "tpu"
+        or "config" not in result  # warm-only results carry no config
+        or result["config"].get("small_shapes")
+    ):
+        return
+    try:
+        import jax as _jax
+
+        rec = dict(result)
+        rec["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        rec["jax_version"] = _jax.__version__
+        path = _bench_memo_path(rec["config"])
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(rec, f, indent=2)
+        print(f"bench: on-chip capture persisted to {path}", file=sys.stderr)
+    except OSError as e:
+        print(f"bench: could not persist capture ({e})", file=sys.stderr)
+
+
+def _committed_tpu_result() -> dict | None:
+    """A committed on-chip capture matching the current config + jax
+    version, or None.  Used ONLY when every live attempt failed: the
+    emitted row keeps platform="tpu" (the number IS a chip measurement)
+    with explicit provenance fields so nobody mistakes it for a live
+    capture."""
+    cfg = _aot_expected_config()
+    try:
+        with open(_bench_memo_path(cfg)) as f:
+            memo = json.load(f)
+        import jax as _jax
+
+        if (
+            memo.get("platform") == "tpu"
+            and memo.get("config") == cfg
+            and memo.get("jax_version") == _jax.__version__
+        ):
+            memo["from_committed_artifact"] = True
+            memo["pool_wedged_at_capture_time"] = True
+            return memo
+    except (OSError, ValueError):
+        pass
+    return None
 
 
 def _run_aot(timeout: float | None = None) -> dict | None:
@@ -636,17 +700,20 @@ def main() -> None:
             )
 
     last_rc, last_err = 0, ""
+    saw_wedge = False
     extra_env: dict[str, str] = {}
     for attempt in range(1, retries + 1):
         env = {**os.environ, **extra_env} if extra_env else None
         rc, result, err = _run_attempt(attempt_timeout, env=env)
         if result is not None:
+            _persist_tpu_result(result)
             if aot_block is not None:
                 result["aot_tpu"] = aot_block
             print(json.dumps(result))
             return
         last_rc, last_err = rc, err
         wedged = rc in (3, -9)
+        saw_wedge = saw_wedge or wedged
         mismatch = "libtpu version mismatch" in (err or "")
         print(
             f"bench: attempt {attempt}/{retries} failed rc={rc}"
@@ -681,6 +748,23 @@ def main() -> None:
             extra_env["BENCH_REMAT"] = "1"
         if attempt < retries:
             time.sleep(backoff)
+    # a committed on-chip capture of THIS config beats any CPU stand-in —
+    # but ONLY when the failures look like a wedged pool (rc 3 / SIGKILL
+    # on device init).  A bench-code regression (other rcs) must stay
+    # loudly broken, not be masked by an old healthy number.
+    committed = _committed_tpu_result() if saw_wedge else None
+    if committed is not None:
+        committed["live_failure_rc"] = last_rc
+        print(
+            f"bench: all {retries} live attempts failed (last rc={last_rc}) "
+            "but a committed on-chip capture of this exact config exists — "
+            f"emitting it (measured_at={committed.get('measured_at')})",
+            file=sys.stderr,
+        )
+        if aot_block is not None:
+            committed["aot_tpu"] = aot_block
+        print(json.dumps(committed))
+        return
     print(
         f"bench: all {retries} attempts failed (last rc={last_rc}); "
         "the TPU pool looks wedged (stale grant on the axon relay) — "

@@ -1,0 +1,81 @@
+"""Guards for bench.py's committed on-chip capture memo (the round-3
+failure mode: a real TPU measurement existed mid-round, but the driver's
+end-of-round bench hit a wedged pool and recorded a CPU fallback)."""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def bench(monkeypatch):
+    sys.argv = ["bench"]
+    for var in (
+        "BENCH_SMALL",
+        "BENCH_REMAT",
+        "BENCH_REMAT_POLICY",
+        "BENCH_BATCH",
+        "BENCH_FUSED",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    spec = importlib.util.spec_from_file_location(
+        "bench_capture_test", os.path.join(REPO, "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_memo_paths_mirror_aot_naming(bench, monkeypatch):
+    assert bench._bench_memo_path(bench._aot_expected_config()).endswith(
+        "bench_tpu.json"
+    )
+    monkeypatch.setenv("BENCH_FUSED", "1")
+    assert bench._bench_memo_path(bench._aot_expected_config()).endswith(
+        "bench_tpu_b64_fused.json"
+    )
+
+
+def test_committed_capture_resolves_for_default_config(bench):
+    """The committed round-3 capture must keep satisfying the memo's
+    config + jax-version keying — this is the driver's wedged-pool
+    fallback to a REAL number."""
+    rec = bench._committed_tpu_result()
+    assert rec is not None
+    assert rec["platform"] == "tpu"
+    assert rec["from_committed_artifact"] is True
+    assert rec["pool_wedged_at_capture_time"] is True
+    assert rec["measured_at"]
+    assert rec["config"] == bench._aot_expected_config()
+
+
+def test_committed_capture_rejected_on_config_drift(bench, monkeypatch):
+    """An exploration config must never silently reuse the default
+    capture."""
+    monkeypatch.setenv("BENCH_BATCH", "128")
+    assert bench._committed_tpu_result() is None
+
+
+def test_persist_refuses_cpu_and_small(bench, tmp_path, monkeypatch):
+    """Only full-shape on-chip measurements may become the committed
+    capture."""
+    calls = []
+    monkeypatch.setattr(
+        bench.json, "dump", lambda *a, **k: calls.append(a)
+    )
+    bench._persist_tpu_result(
+        {"platform": "cpu", "config": {"small_shapes": False}}
+    )
+    bench._persist_tpu_result(
+        {"platform": "tpu", "config": {"small_shapes": True}}
+    )
+    # warm-only child results have no config key at all; must not crash
+    bench._persist_tpu_result({"warm_only": True, "platform": "tpu"})
+    assert calls == []
