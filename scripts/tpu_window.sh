@@ -27,6 +27,9 @@ run() {
     local rc=0
     wait "$pid" || rc=$?
     kill "$watcher" 2>/dev/null; wait "$watcher" 2>/dev/null
+    # reap any group stragglers that caught the TERM (the watcher's -9
+    # escalation is cancelled above once the leader exits)
+    kill -9 -- -"$pid" 2>/dev/null
     echo "=== $name rc=$rc end $(date -u +%F' '%T)" | tee -a "$LOG/driver.log"
 }
 

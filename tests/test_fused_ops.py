@@ -190,6 +190,41 @@ def test_fused_supernet_runs_and_grads():
 
 
 @pytest.mark.slow
+@pytest.mark.slow
+@pytest.mark.parametrize("policy", [None, "dots"])
+def test_fused_composes_with_remat(policy):
+    """The fused plan under jax.checkpoint cells (the batch-scaling
+    configuration combines fused with the dots-saveable policy)."""
+    from katib_tpu.nas.darts.model import DartsNetwork, init_alphas
+
+    net = DartsNetwork(
+        primitives=DEFAULT_PRIMITIVES,
+        init_channels=4,
+        num_layers=1,
+        n_nodes=2,
+        num_classes=10,
+        remat=True,
+        remat_policy=policy,
+        fused_convs=True,
+        dtype=jnp.float32,
+    )
+    key = jax.random.PRNGKey(0)
+    alphas = init_alphas(2, len(DEFAULT_PRIMITIVES), key)
+    x = jax.random.normal(key, (2, 8, 8, 3), jnp.float32)
+    params = net.init(key, x, alphas)
+
+    def loss(w, a):
+        return jnp.mean(net.apply(w, x, a) ** 2)
+
+    val, grads = jax.value_and_grad(loss)(params, alphas)
+    assert np.isfinite(float(val))
+    assert all(
+        np.all(np.isfinite(np.asarray(g)))
+        for g in jax.tree_util.tree_leaves(grads)
+    )
+
+
+@pytest.mark.slow
 def test_fused_supernet_matches_unfused_loss():
     """Same init RNG, mapped params: the fused supernet computes the same
     loss as the unfused one (evaluation plan, not model change)."""
