@@ -467,6 +467,9 @@ def darts_trial(ctx) -> None:
         hyper=hyper,
         mesh=ctx.mesh,
         report=report,
+        # algorithm setting "fused": the fused mixed-op evaluation plan
+        # (nas/darts/fused.py) — a Katib-style CR can request it
+        fused=parse_bool(settings.get("fused")),
         # per-epoch snapshots under the trial's checkpoint dir: a preempted
         # trial re-runs from its last completed epoch, not from scratch
         checkpoint_dir=(

@@ -53,6 +53,10 @@ run 2700 tpe_digits env DEMO_TPU=1 python scripts/run_real_data_demo.py
 # 6. augment phase measured on-chip (fit-proof gate runs deviceless first)
 run 5400 augment python scripts/run_augment_tpu.py
 
+# 6b. flash/ring attention refresh (cheap; keeps the longcontext artifact
+#     on the same libtpu build as the rest of the round's numbers)
+run 2700 longcontext python scripts/run_longcontext_tpu.py
+
 # 7. the 50-epoch flagship search (VERDICT r3 item 2); per-epoch Orbax
 #    checkpoints make this resumable, so a mid-run wedge costs one epoch.
 #    The evaluation plan follows the measured A/B: fused only if step 2
