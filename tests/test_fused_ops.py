@@ -190,6 +190,60 @@ def test_fused_supernet_runs_and_grads():
 
 
 @pytest.mark.slow
+def test_fused_safe_grad_parity_on_model_axis_mesh():
+    """The fused shift-MAC form's parameter gradients on a dp x model
+    mesh equal the single-device dense form's — the same partitioner
+    regression guard as test_depthwise.TestMeshGradParity, for the fused
+    evaluation plan (its grouped convs would hit the miscompiled filter
+    gradient on model-axis meshes; safe=True must not)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from katib_tpu.parallel.mesh import (
+        DATA_AXIS,
+        MODEL_AXIS,
+        make_mesh,
+        replicate,
+        replicated,
+    )
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    c = 8
+    x = jax.random.normal(jax.random.PRNGKey(7), (8, 8, 8, c), jnp.float32)
+    dense = FusedSepDil(c, 1, dtype=jnp.float32, safe=False)
+    params = dense.init(jax.random.PRNGKey(0), x[:1])
+
+    def make_loss(mod):
+        def loss(p, xb):
+            outs = mod.apply(p, xb)
+            return sum((o * o).mean() for o in outs.values())
+
+        return loss
+
+    g0 = jax.device_get(jax.jit(jax.grad(make_loss(dense)))(params, x))
+
+    safe = FusedSepDil(c, 1, dtype=jnp.float32, safe=True)
+    mesh = make_mesh({DATA_AXIS: 4, MODEL_AXIS: 2}, devices=devs[:8])
+    ss = replicated(mesh)
+    bs = NamedSharding(mesh, PartitionSpec(DATA_AXIS))
+    gm = jax.jit(
+        jax.grad(make_loss(safe)), in_shardings=(ss, bs), out_shardings=ss
+    )
+    gmesh = jax.device_get(gm(replicate(params, mesh), jax.device_put(x, bs)))
+
+    flat0 = jax.tree_util.tree_leaves_with_path(g0)
+    flatm = dict(jax.tree_util.tree_leaves_with_path(gmesh))
+    for path, leaf in flat0:
+        np.testing.assert_allclose(
+            np.asarray(leaf),
+            np.asarray(flatm[path]),
+            rtol=2e-5,
+            atol=1e-6,
+            err_msg=f"fused grad diverges on model-axis mesh at {path}",
+        )
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("policy", [None, "dots"])
 def test_fused_composes_with_remat(policy):
