@@ -156,11 +156,16 @@ def setup_jax(
     return jax
 
 
+def artifacts_root() -> str:
+    """The artifact tree root.  KATIB_ARTIFACTS_DIR redirects it —
+    integration tests run the real scripts without clobbering the
+    committed artifacts/ — and every writer AND reader of artifact paths
+    must resolve through here so a redirect can't split them."""
+    return os.environ.get("KATIB_ARTIFACTS_DIR") or os.path.join(REPO, "artifacts")
+
+
 def write_artifact(subdir: str, name: str, payload: dict) -> str:
-    # KATIB_ARTIFACTS_DIR redirects the output tree — integration tests run
-    # the real scripts without clobbering the committed artifacts/
-    root = os.environ.get("KATIB_ARTIFACTS_DIR") or os.path.join(REPO, "artifacts")
-    out_dir = os.path.join(root, subdir)
+    out_dir = os.path.join(artifacts_root(), subdir)
     os.makedirs(out_dir, exist_ok=True)
     path = os.path.join(out_dir, name)
     with open(path, "w") as f:

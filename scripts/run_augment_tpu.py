@@ -204,10 +204,9 @@ def main() -> int:
     # committed proof also lets a later run skip straight to the chip).
     # Read through the same root write_artifact writes, so a
     # KATIB_ARTIFACTS_DIR redirect cannot split the memo's read/write paths
-    art_root = os.environ.get("KATIB_ARTIFACTS_DIR") or os.path.join(
-        REPO, "artifacts"
-    )
-    proof_path = os.path.join(art_root, "flagship", "augment_aot.json")
+    from _common import artifacts_root
+
+    proof_path = os.path.join(artifacts_root(), "flagship", "augment_aot.json")
     proof = None
     if not small:
         # memo keyed on config AND jax version (the bench.py _run_aot

@@ -11,6 +11,9 @@ Artifacts land in ``artifacts/flagship/`` (committed, unlike the gitignored
 - ``run_log.json``  — config, platform, per-epoch accuracy-vs-wallclock,
   step-time stats, images/sec
 - ``genotype.json`` — the discovered cell architecture
+- ``run_progress.jsonl`` — per-epoch stream appended AS the run goes, so
+  a run cut off mid-flight (round end, pool wedge) still leaves evidence
+  of every completed epoch
 
 Dataset honesty: with no egress this runs on the structured synthetic
 CIFAR-10 fallback unless a real ``cifar10.npz`` is present in
@@ -87,6 +90,23 @@ def main() -> int:
     epoch_times: list[float] = []
     last = [time.perf_counter()]
 
+    # per-epoch progress stream: a long run cut off mid-flight (round end,
+    # pool wedge) still leaves committed evidence of every completed epoch
+    # (the Orbax snapshots under ckpt_dir enable resume, but they are
+    # process-local state, not artifact evidence).  Best-effort
+    # throughout: an unwritable artifacts dir must not block the search.
+    from _common import artifacts_root
+
+    progress_path = os.path.join(artifacts_root(), "flagship", "run_progress.jsonl")
+    try:
+        os.makedirs(os.path.dirname(progress_path), exist_ok=True)
+    except OSError:
+        pass
+    # fresh run (no snapshots to resume from) gets a fresh stream — but
+    # truncate LAZILY on the first completed epoch: truncating at startup
+    # would erase the previous run's evidence before this run produced any
+    truncate_first = [not os.path.isdir(ckpt_dir)]
+
     def report(epoch, accuracy, loss):
         now = time.perf_counter()
         epoch_times.append(now - last[0])
@@ -96,6 +116,25 @@ def main() -> int:
             f"epoch_secs={epoch_times[-1]:.1f}",
             flush=True,
         )
+        try:
+            mode = "w" if truncate_first[0] else "a"
+            truncate_first[0] = False
+            with open(progress_path, mode) as f:
+                f.write(
+                    json.dumps(
+                        {
+                            "epoch": epoch,
+                            "accuracy": round(float(accuracy), 4),
+                            "loss": round(float(loss), 4),
+                            "epoch_secs": round(epoch_times[-1], 1),
+                            "platform": platform,
+                            "dataset": ds_name,
+                        }
+                    )
+                    + "\n"
+                )
+        except OSError:
+            pass
         return True
 
     t0 = time.perf_counter()
