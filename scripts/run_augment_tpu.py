@@ -41,7 +41,7 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from _common import REPO, setup_jax, write_artifact  # noqa: E402
+from _common import REPO, artifacts_root, setup_jax, write_artifact  # noqa: E402
 
 V5E_HBM_BYTES = 16 * 1024**3
 PEAK_FLOPS_BF16 = 197e12
@@ -50,7 +50,14 @@ PEAK_FLOPS_BF16 = 197e12
 def _load_genotype():
     from katib_tpu.nas.darts.model import Genotype
 
-    path = os.path.join(REPO, "artifacts", "flagship", "genotype.json")
+    # the redirected tree wins when it holds a genotype (a flagship run
+    # under the same redirect produced it); otherwise fall back to the
+    # committed artifact — a redirect must not break an input-only read
+    path = os.path.join(artifacts_root(), "flagship", "genotype.json")
+    if not os.path.exists(path):
+        committed = os.path.join(REPO, "artifacts", "flagship", "genotype.json")
+        if os.path.exists(committed):
+            path = committed
     with open(path) as f:
         raw = json.load(f)
     to_gene = lambda g: tuple(  # noqa: E731
@@ -204,8 +211,6 @@ def main() -> int:
     # committed proof also lets a later run skip straight to the chip).
     # Read through the same root write_artifact writes, so a
     # KATIB_ARTIFACTS_DIR redirect cannot split the memo's read/write paths
-    from _common import artifacts_root
-
     proof_path = os.path.join(artifacts_root(), "flagship", "augment_aot.json")
     proof = None
     if not small:
@@ -343,7 +348,7 @@ def main() -> int:
     augment_hours = account_epochs * steps_per_epoch * step_secs / 3600.0
     search_hours = None
     try:
-        with open(os.path.join(REPO, "artifacts", "flagship", "bench_tpu.json")) as f:
+        with open(os.path.join(artifacts_root(), "flagship", "bench_tpu.json")) as f:
             bench = json.load(f)
         if bench.get("platform") == "tpu":
             # 50-epoch search at the measured bilevel rate, 25k images/epoch

@@ -26,7 +26,7 @@ import subprocess
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from _common import REPO, write_artifact  # noqa: E402
+from _common import REPO, artifacts_root, write_artifact  # noqa: E402
 
 RESULT_PREFIX = '{"metric"'
 
@@ -49,7 +49,7 @@ def aot_block_for(batch: int, policy: str | None) -> dict | None:
             tag += f"_{policy}"
         name = f"aot_v5e_{tag}.json"
     try:
-        with open(os.path.join(REPO, "artifacts", "flagship", name)) as f:
+        with open(os.path.join(artifacts_root(), "flagship", name)) as f:
             return json.load(f)
     except (OSError, ValueError):
         return None

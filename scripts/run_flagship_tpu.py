@@ -37,7 +37,7 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from _common import REPO, setup_jax, write_artifact  # noqa: E402
+from _common import REPO, artifacts_root, setup_jax, write_artifact  # noqa: E402
 
 
 def main() -> int:
@@ -86,7 +86,19 @@ def main() -> int:
         flush=True,
     )
 
-    ckpt_dir = os.path.join(REPO, ".flagship_ckpt")
+    # config-tagged so runs at different shapes OR data can never resume
+    # from each other's snapshots (a small smoke run must not poison the
+    # 50-epoch run's resume state; a synthetic-data snapshot must not be
+    # restored into a real-data run — shapes match, so Orbax would succeed
+    # silently and poison the provenance); FLAGSHIP_CKPT overrides outright
+    ckpt_tag = (
+        f"b{batch}_l{num_layers}_c{init_channels}_n{n_train}_{ds_name}"
+        + ("_real" if is_real_data(ds_name) else "_syn")
+        + ("_fused" if fused else "")
+    )
+    ckpt_dir = os.environ.get("FLAGSHIP_CKPT") or os.path.join(
+        REPO, f".flagship_ckpt_{ckpt_tag}"
+    )
     epoch_times: list[float] = []
     last = [time.perf_counter()]
 
@@ -95,8 +107,6 @@ def main() -> int:
     # (the Orbax snapshots under ckpt_dir enable resume, but they are
     # process-local state, not artifact evidence).  Best-effort
     # throughout: an unwritable artifacts dir must not block the search.
-    from _common import artifacts_root
-
     progress_path = os.path.join(artifacts_root(), "flagship", "run_progress.jsonl")
     try:
         os.makedirs(os.path.dirname(progress_path), exist_ok=True)
@@ -104,8 +114,22 @@ def main() -> int:
         pass
     # fresh run (no snapshots to resume from) gets a fresh stream — but
     # truncate LAZILY on the first completed epoch: truncating at startup
-    # would erase the previous run's evidence before this run produced any
-    truncate_first = [not os.path.isdir(ckpt_dir)]
+    # would erase the previous run's evidence before this run produced any.
+    # The stream is shared across configs, so truncation additionally
+    # requires the existing file's last record to carry OUR config tag —
+    # a small smoke run must append alongside (not erase) the evidence of
+    # an interrupted full-size run that is still resumable
+    def _last_tag_matches() -> bool:
+        try:
+            with open(progress_path) as f:
+                lines = [ln for ln in f if ln.strip()]
+            if not lines:
+                return True
+            return json.loads(lines[-1]).get("config") == ckpt_tag
+        except (OSError, ValueError):
+            return True  # unreadable/corrupt stream: safe to replace
+
+    truncate_first = [not os.path.isdir(ckpt_dir) and _last_tag_matches()]
 
     def report(epoch, accuracy, loss):
         now = time.perf_counter()
@@ -118,8 +142,11 @@ def main() -> int:
         )
         try:
             mode = "w" if truncate_first[0] else "a"
-            truncate_first[0] = False
             with open(progress_path, mode) as f:
+                # only a successful open consumes the truncation — a
+                # transient OSError here must not flip later epochs of a
+                # fresh run into appending after the previous run's stream
+                truncate_first[0] = False
                 f.write(
                     json.dumps(
                         {
@@ -129,6 +156,7 @@ def main() -> int:
                             "epoch_secs": round(epoch_times[-1], 1),
                             "platform": platform,
                             "dataset": ds_name,
+                            "config": ckpt_tag,
                         }
                     )
                     + "\n"

@@ -221,7 +221,7 @@ class TestBlackboxRunner:
         store = MemoryObservationStore()
         code = (
             "import time\n"
-            "for i in range(100):\n"
+            "for i in range(200):\n"
             "    print(f'accuracy=0.01')\n"
             "    time.sleep(0.05)\n"
         )
@@ -229,7 +229,11 @@ class TestBlackboxRunner:
         t0 = time.time()
         res = run_trial(self._script_trial(code, rules=rules), store, OBJ)
         assert res.condition is TrialCondition.EARLY_STOPPED
-        assert time.time() - t0 < 4.0  # killed long before 5s of sleeps
+        # killed long before the 10s of sleeps; the slack above the ~0.15s
+        # of pre-trigger script time absorbs interpreter startup on a
+        # loaded 1-core box (a full run still takes >=10s, so the bound
+        # discriminates)
+        assert time.time() - t0 < 7.0
 
 
 class TestOrchestrator:
