@@ -26,7 +26,12 @@ import subprocess
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from _common import REPO, artifacts_root, write_artifact  # noqa: E402
+from _common import (  # noqa: E402
+    REPO,
+    _local_compile_probe,
+    artifacts_root,
+    write_artifact,
+)
 
 RESULT_PREFIX = '{"metric"'
 
@@ -82,9 +87,18 @@ def main() -> int:
             BENCH_BATCH=str(batch),
             BENCH_SKIP_AOT="1",
             BENCH_NO_FALLBACK="1",
-            BENCH_RETRIES="1",
+            # 2, not 1: bench's libtpu-mismatch auto-flip to terminal-side
+            # compile happens on the attempt AFTER the mismatch is seen —
+            # a single attempt fails before the flip can ever fire (this
+            # exact footgun burned the first on-chip scaling run)
+            BENCH_RETRIES="2",
             BENCH_STEPS=steps,
         )
+        # consult the cached compile-locality verdict up front so attempt 1
+        # already compiles on the correct side instead of burning an
+        # attempt rediscovering the mismatch per point
+        if _local_compile_probe() is False:
+            env["KATIB_REMOTE_COMPILE"] = "1"
         if policy is not None:
             env.update(BENCH_REMAT="1", BENCH_REMAT_POLICY=policy)
         else:
