@@ -1,0 +1,63 @@
+#!/usr/bin/env bash
+# Second healthy-window queue (round 4): the follow-ups that depend on
+# window 1's results — the batch-scaling rerun (its first attempt burned
+# both points on the BENCH_RETRIES=1 footgun, since fixed), the op
+# microbench regenerated with the measured scan-iteration floor, and the
+# 32-trial Hyperband sweep serialized onto the real chip (trials/hour,
+# the BASELINE driver metric, with on-chip compile-once economics).
+#
+# Waits for window 1 (scripts/tpu_window.sh) to release the chip first.
+# Usage: bash scripts/tpu_window2.sh   (detached)
+# Logs:  /tmp/tpu_window2/<step>.log
+set -u
+cd "$(dirname "$0")/.."
+LOG=/tmp/tpu_window2
+mkdir -p "$LOG"
+
+# wait (up to 6h) for window 1 to finish so the two queues never contend
+# for the single chip
+for _ in $(seq 720); do
+    if grep -q "window complete" /tmp/tpu_window/driver.log 2>/dev/null; then
+        break
+    fi
+    if ! pgrep -f "tpu_window.sh" | grep -qv $$; then
+        break  # window 1 is not running at all
+    fi
+    sleep 30
+done
+
+run() {
+    local t=$1 name=$2; shift 2
+    echo "=== $name start $(date -u +%F' '%T)" | tee -a "$LOG/driver.log"
+    setsid "$@" >"$LOG/$name.log" 2>&1 &
+    local pid=$!
+    ( sleep "$t" && kill -- -"$pid" 2>/dev/null && sleep 20 \
+        && kill -9 -- -"$pid" 2>/dev/null ) &
+    local watcher=$!
+    local rc=0
+    wait "$pid" || rc=$?
+    kill "$watcher" 2>/dev/null; wait "$watcher" 2>/dev/null
+    kill -9 -- -"$pid" 2>/dev/null
+    echo "=== $name rc=$rc end $(date -u +%F' '%T)" | tee -a "$LOG/driver.log"
+}
+
+# 1. batch scaling at the proven configs (b64 no-remat, b128 dots) with
+#    the compile-locality fix — the remaining throughput lever
+run 5400 batch_scaling python scripts/run_batch_scaling.py
+
+# 2. op microbench with the explicit scan-floor measurement
+run 2700 op_microbench python scripts/run_op_microbench.py
+
+# 3. 32-trial Hyperband sweep serialized onto the real chip: real digits
+#    CNN trials, per-trial wall-clocks show the compile-once economics.
+#    Redirected so it can't clobber the committed CPU-mesh sweep artifact;
+#    the result is copied in under its own name afterwards.
+run 5400 hyperband_tpu env SWEEP_PLATFORM=axon \
+    KATIB_ARTIFACTS_DIR=/tmp/tpu_window2/artifacts \
+    python scripts/run_hyperband_sweep.py
+if [ -f /tmp/tpu_window2/artifacts/hyperband/sweep_summary.json ]; then
+    cp /tmp/tpu_window2/artifacts/hyperband/sweep_summary.json \
+       artifacts/hyperband/sweep_summary_tpu.json
+fi
+
+echo "=== window2 complete $(date -u +%F' '%T)" | tee -a "$LOG/driver.log"
