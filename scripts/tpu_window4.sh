@@ -1,0 +1,91 @@
+#!/usr/bin/env bash
+# Round-4 recovery queue: the pool wedged mid-flagship (epoch 15/50
+# checkpointed, resume-ready). Wait for the pool to recover, then run
+# everything the round still needs, highest value first:
+#   1. the 50-epoch flagship resume (picks up at the last Orbax snapshot)
+#   2. batch scaling (b64 / b128-dots) with the compile-locality fix
+#   3. op microbench with the two-point dispatch/marginal fit
+#   4. 32-trial Hyperband sweep serialized on the chip (redirected)
+#   5-7. real-data digits NAS / ENAS / PBT on-chip (redirected)
+# Probes the pool again between steps; a re-wedge skips to the probe
+# rather than burning each step's full timeout.
+# Usage: bash scripts/tpu_window4.sh   (detached)
+# Logs:  /tmp/tpu_window4/<step>.log
+set -u
+cd "$(dirname "$0")/.."
+LOG=/tmp/tpu_window4
+ART=/tmp/tpu_window4/artifacts
+mkdir -p "$LOG"
+
+probe() {
+    env POOL_WATCH_PROBE_TIMEOUT=180 POOL_WATCH_INTERVAL=120 \
+        POOL_WATCH_MAX_HOURS=9 python scripts/pool_watch.py \
+        >>"$LOG/pool_watch.log" 2>&1
+}
+
+run() {
+    local t=$1 name=$2; shift 2
+    echo "=== $name start $(date -u +%F' '%T)" | tee -a "$LOG/driver.log"
+    setsid "$@" >"$LOG/$name.log" 2>&1 &
+    local pid=$!
+    ( sleep "$t" && kill -- -"$pid" 2>/dev/null && sleep 20 \
+        && kill -9 -- -"$pid" 2>/dev/null ) &
+    local watcher=$!
+    local rc=0
+    wait "$pid" || rc=$?
+    kill "$watcher" 2>/dev/null; wait "$watcher" 2>/dev/null
+    kill -9 -- -"$pid" 2>/dev/null
+    echo "=== $name rc=$rc end $(date -u +%F' '%T)" | tee -a "$LOG/driver.log"
+}
+
+probe || exit 1
+
+# 1. flagship resume (epoch 16 onward; ~35.8 s/epoch measured + one
+#    terminal-side recompile if the wedge dropped the server cache)
+run 9000 flagship_resume env FLAGSHIP_EPOCHS=50 FLAGSHIP_BATCH=64 \
+    FLAGSHIP_REMAT=0 FLAGSHIP_FUSED=0 python scripts/run_flagship_tpu.py
+
+probe || exit 1
+
+# 2. batch scaling at the proven configs
+run 5400 batch_scaling python scripts/run_batch_scaling.py
+
+probe || exit 1
+
+# 3. op microbench, two-point fit
+run 2700 op_microbench python scripts/run_op_microbench.py
+
+probe || exit 1
+
+# 4. Hyperband sweep serialized on the chip (redirected, copied in)
+run 5400 hyperband_tpu env SWEEP_PLATFORM=axon KATIB_ARTIFACTS_DIR="$ART" \
+    python scripts/run_hyperband_sweep.py
+[ -f "$ART/hyperband/sweep_summary.json" ] && \
+    cp "$ART/hyperband/sweep_summary.json" artifacts/hyperband/sweep_summary_tpu.json
+
+probe || exit 1
+
+# 5. real-data digits NAS on-chip
+run 3600 nas_digits env DEMO_PLATFORM=axon KATIB_ARTIFACTS_DIR="$ART" \
+    python scripts/run_nas_real_data.py
+[ -f "$ART/real_data/digits_nas.json" ] && \
+    cp "$ART/real_data/digits_nas.json" artifacts/real_data/digits_nas_tpu.json
+
+probe || exit 1
+
+# 6. ENAS on-chip
+run 3600 enas_digits env ENAS_PLATFORM=axon ENAS_DATASET=digits \
+    KATIB_ARTIFACTS_DIR="$ART" python scripts/run_enas_demo.py
+[ -f "$ART/enas/digits_summary.json" ] && \
+    cp "$ART/enas/digits_summary.json" artifacts/enas/digits_summary_tpu.json
+
+probe || exit 1
+
+# 7. PBT on-chip
+run 3600 pbt_digits env PBT_PLATFORM=axon PBT_DATASET=digits \
+    PBT_GENERATIONS=6 KATIB_ARTIFACTS_DIR="$ART" \
+    python scripts/run_pbt_demo.py
+[ -f "$ART/pbt/digits_summary.json" ] && \
+    cp "$ART/pbt/digits_summary.json" artifacts/pbt/digits_summary_tpu.json
+
+echo "=== window4 complete $(date -u +%F' '%T)" | tee -a "$LOG/driver.log"
