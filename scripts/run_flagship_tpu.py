@@ -131,7 +131,37 @@ def main() -> int:
 
     truncate_first = [not os.path.isdir(ckpt_dir) and _last_tag_matches()]
 
+    # mid-run stall watchdog: a wedging pool can block an epoch's scan
+    # dispatch indefinitely inside the runtime (observed live: epoch 16 of
+    # a 50-epoch run hung >18 min in futex_wait while steady-state epochs
+    # take ~36 s).  Per-epoch Orbax snapshots make dying CHEAP — at most
+    # one epoch is lost on resume — so the watchdog exits hard (code 75)
+    # when no epoch completes within the deadline, letting an outer
+    # queue/babysitter probe the pool and relaunch, instead of burning the
+    # whole window timeout blocked.  Armed only after the first completed
+    # epoch: the first one legitimately carries a multi-minute compile.
+    deadline = float(os.environ.get("FLAGSHIP_EPOCH_DEADLINE", "900"))
+    beat = [0.0]  # 0.0 = not armed yet
+
+    def _watchdog():
+        while True:
+            time.sleep(30)
+            if beat[0] and time.perf_counter() - beat[0] > deadline:
+                print(
+                    f"flagship: WATCHDOG no epoch completed in {deadline:.0f}s"
+                    " — pool stall; exiting 75 (resume-safe, snapshots keep"
+                    " all completed epochs)",
+                    flush=True,
+                )
+                os._exit(75)
+
+    if deadline > 0:
+        import threading
+
+        threading.Thread(target=_watchdog, daemon=True).start()
+
     def report(epoch, accuracy, loss):
+        beat[0] = time.perf_counter()
         now = time.perf_counter()
         epoch_times.append(now - last[0])
         last[0] = now
