@@ -16,6 +16,9 @@ Endpoints (JSON unless noted):
 - ``GET /api/trial/<name>/metrics``            raw metric log from the store
 - ``GET /api/experiment/<name>/nas``           NAS graph (nodes/edges) for the
                                                best (or named ``?trial=``) trial
+- ``GET /api/flagship/progress``               per-epoch stream of long NAS runs
+                                               (``artifacts/flagship/run_progress
+                                               .jsonl``), grouped by config tag
 - ``POST /api/experiments``                    create + run a black-box experiment
                                                (body: the YAML spec as JSON, or
                                                ``{"yaml": "<text>"}``) — parity with
@@ -49,6 +52,7 @@ from urllib.parse import parse_qs, urlparse
 from katib_tpu.core.types import ExperimentCondition
 from katib_tpu.orchestrator.status import list_statuses, read_status
 from katib_tpu.store.base import ObservationStore
+from katib_tpu.utils.paths import artifacts_root
 
 
 def _experiment_summary(status: dict) -> dict:
@@ -159,9 +163,14 @@ class UiServer:
         workdir: str,
         store: ObservationStore | None = None,
         token: str | None = None,
+        artifacts_dir: str | None = None,
     ):
         self.workdir = workdir
         self.store = store
+        # flagship run-progress stream lives in the artifacts tree, not the
+        # experiment workdir; the shared resolver keeps this reader and the
+        # scripts/ writers on the same root under a redirect
+        self.artifacts_dir = artifacts_dir or artifacts_root()
         # empty string (e.g. `KATIB_UI_TOKEN=` in a shell) means "no auth",
         # not "require the empty token"
         self.token = (token or os.environ.get("KATIB_UI_TOKEN")) or None
@@ -327,12 +336,40 @@ class UiServer:
         graph["trial"] = trial_name
         return 200, graph
 
+    def flagship_progress(self):
+        """Per-epoch stream of long NAS runs (``run_progress.jsonl``),
+        grouped by config tag — the dashboard's live view of a 50-epoch
+        search, fed by the same file that survives a mid-run cutoff."""
+        path = os.path.join(self.artifacts_dir, "flagship", "run_progress.jsonl")
+        runs: dict[str, list[dict]] = {}
+        try:
+            # errors="replace": a crash mid-append (the exact cutoff this
+            # stream exists to survive) can leave truncated bytes; serve
+            # the parseable prefix instead of 500ing
+            with open(path, errors="replace") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if not isinstance(rec, dict):
+                        continue  # valid JSON but not a record (null, [...])
+                    runs.setdefault(rec.get("config") or "untagged", []).append(rec)
+        except OSError:
+            return 200, {"runs": {}}
+        return 200, {"runs": runs}
+
     def route(self, path: str, query: dict):
         parts = [p for p in path.split("/") if p]
         if not parts:
             return "html", DASHBOARD_HTML
         if parts[0] != "api":
             return 404, {"error": "not found"}
+        if parts[1:] == ["flagship", "progress"]:
+            return self.flagship_progress()
         if parts[1:] == ["experiments"]:
             return self.experiments()
         if len(parts) >= 3 and parts[1] == "experiment":
@@ -519,6 +556,7 @@ print("loss=" + str((${trialParameters.lr}-0.03)**2))</textarea></div>
 <button id="submit">run</button> <span id="createmsg"></span></details>
 <table id="exps"><thead><tr><th>name</th><th>status</th><th>algorithm</th>
 <th>objective</th><th>trials</th><th>best</th><th></th></tr></thead><tbody></tbody></table>
+<div id="flagship"></div>
 <div id="detail"></div>
 <script>
 const esc=s=>String(s??"").replace(/[&<>"]/g,c=>({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;"}[c]));
@@ -529,7 +567,24 @@ function hdrs(){const t=document.getElementById('token').value;
 async function act(u,method,body){const r=await fetch(u,{method,headers:hdrs(),body});
   const p=await r.json();document.getElementById('createmsg').textContent=p.error||'ok';refresh();return p}
 let current=null;
+async function flagshipRuns(){
+  // per-epoch stream of long NAS searches (run_progress.jsonl) — one
+  // accuracy-vs-epoch line per config tag
+  const p=await j('/api/flagship/progress');const runs=p.runs||{};
+  const keys=Object.keys(runs);const el=document.getElementById('flagship');
+  if(!keys.length){el.innerHTML='';return}
+  el.innerHTML='<h2>flagship NAS runs</h2>'+keys.map(k=>{
+    const rows=runs[k],last=rows[rows.length-1],W=260,H=48,n=rows.length;
+    const ys=rows.map(r=>r.accuracy),y0=Math.min(...ys),y1=Math.max(...ys);
+    const px=i=>4+(W-8)*i/((n-1)||1),py=v=>H-4-(H-8)*(v-y0)/((y1-y0)||1);
+    const pts=rows.map((r,i)=>px(i)+','+py(r.accuracy)).join(' ');
+    return `<div style="margin:.4rem 0"><small>${esc(k)} — epoch ${esc(last.epoch)}, `+
+      `val ${esc(last.accuracy)}, ${esc(last.epoch_secs)}s/epoch (${esc(last.platform)})</small><br>`+
+      `<svg width="${W}" height="${H}"><polyline points="${pts}" fill="none" stroke="#15c" stroke-width="2"/></svg></div>`;
+  }).join('');
+}
 async function refresh(){
+  flagshipRuns().catch(()=>{});
   const exps=await j('/api/experiments');
   document.querySelector('#exps tbody').innerHTML=exps.map(e=>{
     const c=e.counts||{},o=e.optimal,n=encodeURIComponent(e.name);

@@ -1,0 +1,25 @@
+"""Shared filesystem-root resolution.
+
+One definition of the artifact tree root, used by BOTH writers (the
+``scripts/`` harnesses via ``scripts/_common.write_artifact``) and readers
+(the dashboard's flagship-progress endpoint) — a ``KATIB_ARTIFACTS_DIR``
+redirect must move every producer and consumer together or evidence
+silently splits across trees.
+"""
+
+from __future__ import annotations
+
+import os
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def artifacts_root() -> str:
+    """The artifact tree root; ``KATIB_ARTIFACTS_DIR`` redirects it
+    (integration tests run the real scripts without clobbering the
+    committed ``artifacts/``)."""
+    return os.environ.get("KATIB_ARTIFACTS_DIR") or os.path.join(
+        _REPO_ROOT, "artifacts"
+    )

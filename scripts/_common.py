@@ -160,8 +160,12 @@ def artifacts_root() -> str:
     """The artifact tree root.  KATIB_ARTIFACTS_DIR redirects it —
     integration tests run the real scripts without clobbering the
     committed artifacts/ — and every writer AND reader of artifact paths
-    must resolve through here so a redirect can't split them."""
-    return os.environ.get("KATIB_ARTIFACTS_DIR") or os.path.join(REPO, "artifacts")
+    must resolve through here so a redirect can't split them.  One
+    definition, shared with in-package readers (the dashboard's
+    flagship-progress endpoint)."""
+    from katib_tpu.utils.paths import artifacts_root as _shared
+
+    return _shared()
 
 
 def write_artifact(subdir: str, name: str, payload: dict) -> str:

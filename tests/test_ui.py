@@ -525,3 +525,46 @@ class TestTrialLogResolution:
         assert read_trial_log(workdir, "t-2") == "conventional\n"
         # unsafe names refuse
         assert find_trial_log(workdir, "../t-1") is None
+
+
+class TestFlagshipProgress:
+    """/api/flagship/progress serves the per-epoch run stream, grouped by
+    config tag — the dashboard's live view of a long NAS search (fed by the
+    same run_progress.jsonl that survives a mid-run cutoff)."""
+
+    def test_grouped_by_config_and_garbage_tolerant(self, tmp_path):
+        from katib_tpu.ui.backend import UiServer
+
+        art = tmp_path / "art" / "flagship"
+        art.mkdir(parents=True)
+        rows = [
+            {"epoch": 0, "accuracy": 0.5, "config": "b64", "platform": "tpu"},
+            {"epoch": 1, "accuracy": 0.6, "config": "b64", "platform": "tpu"},
+            {"epoch": 0, "accuracy": 0.1, "config": "b16", "platform": "cpu"},
+        ]
+        (art / "run_progress.jsonl").write_text(
+            "\n".join(json.dumps(r) for r in rows)
+            # garbage classes the reader must skip, not 500 on: broken
+            # syntax, valid-JSON non-records, and truncated bytes from a
+            # crash mid-append
+            + "\nnot json\nnull\n[1,2]\n"
+        )
+        with open(art / "run_progress.jsonl", "ab") as f:
+            f.write(b'{"epoch": 9, "accuracy": 0.9, "config": "b64\xc3')
+        ui = UiServer(str(tmp_path), artifacts_dir=str(tmp_path / "art"))
+        status, payload = ui.route("api/flagship/progress", {})
+        assert status == 200
+        assert [r["epoch"] for r in payload["runs"]["b64"]] == [0, 1]
+        assert payload["runs"]["b16"][0]["platform"] == "cpu"
+
+    def test_missing_stream_is_empty_not_error(self, tmp_path):
+        from katib_tpu.ui.backend import UiServer
+
+        ui = UiServer(str(tmp_path), artifacts_dir=str(tmp_path / "nope"))
+        assert ui.route("api/flagship/progress", {}) == (200, {"runs": {}})
+
+    def test_dashboard_carries_flagship_panel(self, tmp_path):
+        from katib_tpu.ui.backend import DASHBOARD_HTML
+
+        for hook in ("flagshipRuns", "/api/flagship/progress", 'id="flagship"'):
+            assert hook in DASHBOARD_HTML, hook
