@@ -329,7 +329,10 @@ class TestDbManagerCommand:
             # this a REAL daemon-crash durability exercise
             os.kill(proc.pid, signal.SIGKILL)
             proc.wait()
-        deadline = time.monotonic() + 5.0
+        # generous: PDEATHSIG delivery is prompt, but a loaded 1-core box
+        # can starve the probe loop itself (observed flaking at 5s under a
+        # full parallel suite while passing in isolation)
+        deadline = time.monotonic() + 20.0
         while time.monotonic() < deadline:
             try:
                 probe = RemoteObservationStore(port=port, timeout=0.3)
