@@ -76,6 +76,7 @@ def run_darts_search(
     remat_policy: str | None = None,
     device_data: bool | None = None,
     fused: bool = False,
+    scan_unroll: int | None = None,
 ) -> dict[str, Any]:
     """Run the bilevel architecture search; returns genotype + final metrics.
 
@@ -212,13 +213,24 @@ def run_darts_search(
             jax.device_put(a) for a in (x_w, y_w, x_a, y_a)
         )
 
+        # unroll>1 inlines that many bilevel steps per XLA While-loop
+        # iteration — the microbench found a fixed ~1.35-1.5 ms
+        # per-scan-iteration floor (artifacts/flagship/op_microbench.json),
+        # and unrolling amortizes it at the cost of a proportionally
+        # bigger program (longer compile, more code HBM).  Default 1;
+        # KATIB_SCAN_UNROLL overrides for the A/B harness.
+        if scan_unroll is None:
+            scan_unroll = int(os.environ.get("KATIB_SCAN_UNROLL", "1"))
+
         def _epoch(state, xw, yw, xa, ya, w_ix, a_ix):
             def body(s, ix):
                 wi, ai = ix
                 s, m = search_step(s, (xw[wi], yw[wi]), (xa[ai], ya[ai]))
                 return s, m["train_loss"]
 
-            return jax.lax.scan(body, state, (w_ix, a_ix))
+            return jax.lax.scan(
+                body, state, (w_ix, a_ix), unroll=max(1, scan_unroll)
+            )
 
         # donate the carried state: the bilevel step holds two full weight
         # copies already — double-buffering a third across the epoch call

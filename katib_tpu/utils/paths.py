@@ -16,10 +16,32 @@ _REPO_ROOT = os.path.dirname(
 )
 
 
+def _default_root() -> str:
+    """Package-relative ``artifacts/`` — but if the package was imported
+    from an installed copy (site-packages) that default points at a tree
+    the scripts never write, so fall back to searching upward from the
+    working directory for a checkout that actually has one."""
+    pkg_rel = os.path.join(_REPO_ROOT, "artifacts")
+    if os.path.isdir(pkg_rel):
+        return pkg_rel
+    d = os.getcwd()
+    while True:
+        cand = os.path.join(d, "artifacts")
+        # require this repo's marker, not just any directory that happens
+        # to be named artifacts/ — an unrelated project's tree must not
+        # silently capture every write_artifact
+        if os.path.isfile(os.path.join(cand, "README.md")) and os.path.isdir(
+            os.path.join(d, "katib_tpu")
+        ):
+            return cand
+        parent = os.path.dirname(d)
+        if parent == d:
+            return pkg_rel  # nothing found: keep the package-relative path
+        d = parent
+
+
 def artifacts_root() -> str:
     """The artifact tree root; ``KATIB_ARTIFACTS_DIR`` redirects it
     (integration tests run the real scripts without clobbering the
     committed ``artifacts/``)."""
-    return os.environ.get("KATIB_ARTIFACTS_DIR") or os.path.join(
-        _REPO_ROOT, "artifacts"
-    )
+    return os.environ.get("KATIB_ARTIFACTS_DIR") or _default_root()

@@ -63,6 +63,11 @@ def aot_block_for(batch: int, policy: str | None) -> dict | None:
 def main() -> int:
     configs = parse_configs(os.environ.get("SCALING_CONFIGS", "64:none,128:dots"))
     steps = os.environ.get("BENCH_STEPS", "5")
+    # probe once, outside the loop: the verdict cannot change between the
+    # points of one invocation, and an inconclusive (None) probe on a
+    # wedged pool would otherwise charge every point its full timeout
+    # before the bench child even starts
+    remote_compile = _local_compile_probe() is False
     points: list[dict] = []
     for batch, policy in configs:
         aot = aot_block_for(batch, policy)
@@ -97,7 +102,7 @@ def main() -> int:
         # consult the cached compile-locality verdict up front so attempt 1
         # already compiles on the correct side instead of burning an
         # attempt rediscovering the mismatch per point
-        if _local_compile_probe() is False:
+        if remote_compile:
             env["KATIB_REMOTE_COMPILE"] = "1"
         if policy is not None:
             env.update(BENCH_REMAT="1", BENCH_REMAT_POLICY=policy)

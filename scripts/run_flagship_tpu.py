@@ -112,24 +112,31 @@ def main() -> int:
         os.makedirs(os.path.dirname(progress_path), exist_ok=True)
     except OSError:
         pass
-    # fresh run (no snapshots to resume from) gets a fresh stream — but
-    # truncate LAZILY on the first completed epoch: truncating at startup
-    # would erase the previous run's evidence before this run produced any.
-    # The stream is shared across configs, so truncation additionally
-    # requires the existing file's last record to carry OUR config tag —
-    # a small smoke run must append alongside (not erase) the evidence of
-    # an interrupted full-size run that is still resumable
-    def _last_tag_matches() -> bool:
+    # fresh run (no snapshots to resume from) gets a fresh stream for ITS
+    # config — but rewrite LAZILY on the first completed epoch: rewriting at
+    # startup would erase the previous run's evidence before this run
+    # produced any.  The stream is shared across configs, so the rewrite
+    # keeps every record whose tag differs from ours (a small smoke run
+    # must never erase an interrupted full-size run's still-resumable
+    # evidence, no matter what order runs interleave in) and drops only OUR
+    # tag's stale records (so repeated fresh runs can't concatenate
+    # duplicate epoch series under one tag).
+    def _keep_other_tags() -> list[str]:
         try:
             with open(progress_path) as f:
                 lines = [ln for ln in f if ln.strip()]
-            if not lines:
-                return True
-            return json.loads(lines[-1]).get("config") == ckpt_tag
-        except (OSError, ValueError):
-            return True  # unreadable/corrupt stream: safe to replace
+        except OSError:
+            return []
+        kept = []
+        for ln in lines:
+            try:
+                if json.loads(ln).get("config") != ckpt_tag:
+                    kept.append(ln if ln.endswith("\n") else ln + "\n")
+            except ValueError:
+                continue  # drop corrupt records
+        return kept
 
-    truncate_first = [not os.path.isdir(ckpt_dir) and _last_tag_matches()]
+    rewrite_first = [not os.path.isdir(ckpt_dir)]
 
     # mid-run stall watchdog: a wedging pool can block an epoch's scan
     # dispatch indefinitely inside the runtime (observed live: epoch 16 of
@@ -143,9 +150,12 @@ def main() -> int:
     deadline = float(os.environ.get("FLAGSHIP_EPOCH_DEADLINE", "900"))
     beat = [0.0]  # 0.0 = not armed yet
 
+    # poll at deadline/4 (cap 30 s): frequent enough that a short test
+    # deadline fires promptly, infrequent enough to cost nothing at the
+    # production 900 s deadline
     def _watchdog():
         while True:
-            time.sleep(30)
+            time.sleep(min(30.0, max(0.5, deadline / 4.0)))
             if beat[0] and time.perf_counter() - beat[0] > deadline:
                 print(
                     f"flagship: WATCHDOG no epoch completed in {deadline:.0f}s"
@@ -160,8 +170,17 @@ def main() -> int:
 
         threading.Thread(target=_watchdog, daemon=True).start()
 
+    # test-only stall injection: after epoch K's snapshot lands, hang the
+    # epoch loop so the watchdog's exit-75/resume cycle can be exercised
+    # in anger on CPU (tests/test_cifar_ready_path.py) instead of waiting
+    # for a live pool wedge to prove it
+    stall_after = os.environ.get("FLAGSHIP_TEST_STALL_AFTER_EPOCH")
+
     def report(epoch, accuracy, loss):
         beat[0] = time.perf_counter()
+        if stall_after is not None and epoch == int(stall_after):
+            print(f"flagship: TEST STALL injected after epoch {epoch}", flush=True)
+            time.sleep(10 * deadline if deadline > 0 else 3600)
         now = time.perf_counter()
         epoch_times.append(now - last[0])
         last[0] = now
@@ -171,12 +190,15 @@ def main() -> int:
             flush=True,
         )
         try:
-            mode = "w" if truncate_first[0] else "a"
-            with open(progress_path, mode) as f:
-                # only a successful open consumes the truncation — a
-                # transient OSError here must not flip later epochs of a
-                # fresh run into appending after the previous run's stream
-                truncate_first[0] = False
+            if rewrite_first[0]:
+                kept = _keep_other_tags()
+                with open(progress_path, "w") as f:
+                    # only a successful open consumes the rewrite — a
+                    # transient OSError must not flip later epochs of a
+                    # fresh run into appending after stale same-tag records
+                    rewrite_first[0] = False
+                    f.writelines(kept)
+            with open(progress_path, "a") as f:
                 f.write(
                     json.dumps(
                         {

@@ -48,7 +48,7 @@ def main() -> int:
 
     key = jax.random.PRNGKey(0)
 
-    def timed(apply_fn, x, label):
+    def timed(apply_fn, x, label, unroll=1):
         """(seconds per application, seconds fixed overhead per dispatch).
 
         The first on-chip run timed one N-step scan and divided by N —
@@ -58,6 +58,10 @@ def main() -> int:
         lengths and fit: per_iter = (T(4N) - T(N)) / 3N isolates the true
         marginal iteration cost; overhead = T(N) - N*per_iter is the
         dispatch+fetch cost the relay charges once per jit call.
+
+        ``unroll``: inline that many body applications per XLA While
+        iteration — the A/B for whether the per-iteration floor is loop
+        machinery (amortizes with unroll) or per-op cost (does not).
         """
 
         def make_many(n):
@@ -75,7 +79,7 @@ def main() -> int:
                     # reduced scalar back so iterations still chain
                     dep = jnp.mean(out.astype(jnp.float32)) * jnp.float32(1e-6)
                     return c + dep.astype(c.dtype), None
-                return jax.lax.scan(body, x0, None, length=n)[0]
+                return jax.lax.scan(body, x0, None, length=n, unroll=unroll)[0]
 
             return many
 
@@ -120,10 +124,12 @@ def main() -> int:
         lambda a: a * jnp.float32(1.0).astype(a.dtype), x_floor, "scan_floor_identity"
     )
 
-    def bench_module(mod, c, label, shape=None):
+    def bench_module(mod, c, label, shape=None, unroll=1):
         x = jax.random.normal(key, shape or (batch, hw, hw, c), jnp.bfloat16)
         params = mod.init(jax.random.PRNGKey(1), x)
-        results[label] = timed(lambda a: mod.apply(params, a), x, label)
+        results[label] = timed(
+            lambda a: mod.apply(params, a), x, label, unroll=unroll
+        )
 
     for c in (16, 64):
         bench_module(DepthwiseConv(kernel=3, dtype=jnp.bfloat16), c, f"dw3_c{c}")
@@ -166,6 +172,28 @@ def main() -> int:
 
     results["cell_c16_fwd_bwd"] = timed(
         lambda a: jax.grad(lambda q: cell_loss(q))(a), x16, "cell_c16_fwd_bwd"
+    )
+
+    # scan-unroll A/B (VERDICT r4 item 3): if the per-iteration floor is
+    # While-loop machinery it amortizes ~1/unroll; if it is per-op cost
+    # inside the body, unrolled entries match their unroll=1 twins
+    results["scan_floor_identity_u8"] = timed(
+        lambda a: a * jnp.float32(1.0).astype(a.dtype),
+        x_floor,
+        "scan_floor_identity_u8",
+        unroll=8,
+    )
+    bench_module(
+        DepthwiseConv(kernel=3, dtype=jnp.bfloat16), 16, "dw3_c16_u8", unroll=8
+    )
+    results["cell_c16_fwd_u4"] = timed(
+        lambda a: cell.apply(cparams, a, a, cw), x16, "cell_c16_fwd_u4", unroll=4
+    )
+    results["cell_c16_fwd_bwd_u4"] = timed(
+        lambda a: jax.grad(lambda q: cell_loss(q))(a),
+        x16,
+        "cell_c16_fwd_bwd_u4",
+        unroll=4,
     )
 
     out = {

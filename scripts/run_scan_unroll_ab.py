@@ -1,0 +1,138 @@
+"""Scan-unroll A/B for the flagship epoch loop, on-chip.
+
+VERDICT r4 item 3: the op microbench exposed a fixed ~1.35-1.5 ms
+per-scan-iteration floor that dwarfs the ~4.6 ms marginal cost of a whole
+cell.  If that floor is XLA While-loop machinery, inlining several bilevel
+steps per loop iteration (``lax.scan(..., unroll=k)``) amortizes it; if it
+is per-op cost inside the body, unrolling buys nothing and the artifact
+honestly refutes the lever — either way the measurement is kept, like the
+fused-plan A/B (``artifacts/flagship/bench_tpu_b64_fused.json``).
+
+Measures a K-step scan over the FULL-SIZE second-order bilevel step (the
+exact program ``run_darts_search(device_data=True)`` dispatches per epoch,
+``nas/darts/search.py``) at each requested unroll factor.  Timing
+discipline per docs/performance.md: one dispatch per measurement, clock
+stopped on a host-fetched scalar.
+
+Artifact: ``artifacts/flagship/scan_unroll_ab.json``.
+Env: UNROLL_FACTORS (default ``1,2``), UNROLL_STEPS (scan length, default
+8), UNROLL_SMALL=1 (CPU smoke shapes), BENCH_BATCH etc. pass through to
+the shared model builder.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import REPO, setup_jax, write_artifact  # noqa: E402
+
+sys.path.insert(0, REPO)  # for bench.py's shared model builder
+
+
+def main() -> int:
+    from katib_tpu.utils.booleans import parse_bool
+
+    small = parse_bool(os.environ.get("UNROLL_SMALL"))
+    if small:
+        os.environ.setdefault("BENCH_SMALL", "1")
+    jax = setup_jax(compile_cache=True)
+    import jax.numpy as jnp
+
+    from bench import _build_flagship
+
+    factors = [
+        int(f)
+        for f in os.environ.get("UNROLL_FACTORS", "1,2").split(",")
+        if f.strip()
+    ]
+    k_steps = int(os.environ.get("UNROLL_STEPS", "2" if small else "8"))
+    platform = jax.devices()[0].platform
+
+    step, state, batch, net, remat = _build_flagship(jax, jnp)
+    x, y = batch
+    # K distinct batches so no iteration's work can be CSE'd away
+    keyb = jax.random.PRNGKey(7)
+    xs = x[None] + 1e-3 * jax.random.normal(
+        keyb, (k_steps, *x.shape), x.dtype
+    )
+    ys = jnp.tile(y[None], (k_steps, 1))
+
+    def make_epoch(u):
+        def epoch(s, xs, ys):
+            def body(c, b):
+                xb, yb = b
+                c, m = step(c, (xb, yb), (xb, yb))
+                return c, m["train_loss"]
+
+            return jax.lax.scan(body, s, (xs, ys), unroll=u)
+
+        return jax.jit(epoch)
+
+    @jax.jit
+    def redsum(s):
+        return sum(
+            jnp.sum(a.astype(jnp.float32)) for a in jax.tree_util.tree_leaves(s)
+        )
+
+    points = []
+    for u in factors:
+        epoch = make_epoch(u)
+        print(f"unroll_ab: compiling unroll={u} (K={k_steps}) ...", flush=True)
+        t0 = time.perf_counter()
+        s1, _ = epoch(state, xs, ys)
+        float(redsum(s1))  # compile + first run, fetch-forced
+        compile_secs = time.perf_counter() - t0
+        times = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            s1, losses = epoch(state, xs, ys)
+            float(redsum(losses))
+            times.append(time.perf_counter() - t0)
+        dt = min(times)
+        step_secs = dt / k_steps
+        img_per_sec = x.shape[0] * k_steps / dt
+        points.append(
+            {
+                "unroll": u,
+                "scan_steps": k_steps,
+                "step_secs": round(step_secs, 4),
+                "images_per_sec": round(img_per_sec, 2),
+                "compile_secs": round(compile_secs, 1),
+            }
+        )
+        print(
+            f"unroll_ab: unroll={u}: {step_secs*1e3:.1f} ms/step "
+            f"({img_per_sec:.1f} img/s, compile {compile_secs:.0f}s)",
+            flush=True,
+        )
+
+    base = next((p for p in points if p["unroll"] == 1), points[0])
+    out = {
+        "what": (
+            "K-step scan over the full-size second-order bilevel step at "
+            "each unroll factor; one dispatch per measurement, clock ends "
+            "on a host-fetched scalar (docs/performance.md)"
+        ),
+        "platform": platform,
+        "config": {
+            "batch": int(x.shape[0]),
+            "small_shapes": small,
+            "remat": remat,
+        },
+        "points": points,
+        "speedup_vs_unroll1": {
+            str(p["unroll"]): round(base["step_secs"] / p["step_secs"], 3)
+            for p in points
+        },
+    }
+    write_artifact("flagship", "scan_unroll_ab.json", out)
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

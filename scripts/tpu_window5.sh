@@ -1,0 +1,137 @@
+#!/usr/bin/env bash
+# Round-5 window queue — fires the moment pool_watch sees a healthy pool,
+# highest value first (VERDICT r4 "Next round" order):
+#   1. live driver bench (item 8 — a live capture, not the memo)
+#   2. 50-epoch flagship resume, retried across mid-run stalls (item 1)
+#   3. augment of the discovered genotype to 20 epochs (item 1, phase 2)
+#   4. batch scaling b64/b96-dots/b128-dots (item 2)
+#   5. 32-trial Hyperband sweep on-chip (item 5)
+#   6. op microbench two-point fit + unroll atoms (item 3)
+#   7. full-step scan-unroll A/B (item 3)
+#   8. 20-cell paper-protocol augment step timing (item 4)
+#   9. real-data digits NAS / ENAS / PBT on-chip (carried from window4)
+#  10. closing live bench (fresh memo + warm cache for the driver)
+# Probes between steps; a re-wedge waits for recovery instead of burning
+# each step's timeout.
+# Usage: setsid bash scripts/tpu_window5.sh &   Logs: /tmp/tpu_window5/
+set -u
+cd "$(dirname "$0")/.."
+LOG=/tmp/tpu_window5
+ART=/tmp/tpu_window5/artifacts
+mkdir -p "$LOG"
+
+probe() {
+    env POOL_WATCH_PROBE_TIMEOUT=180 POOL_WATCH_INTERVAL=120 \
+        POOL_WATCH_MAX_HOURS=10 python scripts/pool_watch.py \
+        >>"$LOG/pool_watch.log" 2>&1
+}
+
+run() {
+    # own process group + group kill on deadline (tpu_window.sh rationale)
+    local t=$1 name=$2; shift 2
+    echo "=== $name start $(date -u +%F' '%T)" | tee -a "$LOG/driver.log"
+    setsid "$@" >"$LOG/$name.log" 2>&1 &
+    local pid=$!
+    ( sleep "$t" && kill -- -"$pid" 2>/dev/null && sleep 20 \
+        && kill -9 -- -"$pid" 2>/dev/null ) &
+    local watcher=$!
+    local rc=0
+    wait "$pid" || rc=$?
+    kill "$watcher" 2>/dev/null; wait "$watcher" 2>/dev/null
+    kill -9 -- -"$pid" 2>/dev/null
+    echo "=== $name rc=$rc end $(date -u +%F' '%T)" | tee -a "$LOG/driver.log"
+    return $rc
+}
+
+probe || exit 1
+
+# 1. live driver bench (program cached terminal-side from r4 → minutes)
+run 5400 bench env BENCH_RETRIES=2 python bench.py
+
+probe || exit 1
+
+# 2. flagship resume → epoch 50.  Watchdog exits 75 on a mid-run stall
+#    (resume-safe); loop probe+relaunch up to 4 attempts so one wedge
+#    doesn't end the search at epoch N<50 again.
+for attempt in 1 2 3 4; do
+    run 9000 flagship_resume_$attempt env FLAGSHIP_EPOCHS=50 \
+        FLAGSHIP_BATCH=64 FLAGSHIP_REMAT=0 FLAGSHIP_FUSED=0 \
+        python scripts/run_flagship_tpu.py
+    rc=$?
+    [ "$rc" -eq 0 ] && break
+    echo "=== flagship attempt $attempt rc=$rc — reprobing" >>"$LOG/driver.log"
+    probe || exit 1
+done
+
+probe || exit 1
+
+# 3. augment the discovered genotype: accuracy-vs-epoch + honest timing
+run 5400 augment_genotype env AUGMENT_EPOCHS=20 python scripts/run_augment_tpu.py
+
+probe || exit 1
+
+# 4. batch scaling (b96 point auto-skips unless its AOT fit-proof landed)
+run 8000 batch_scaling env SCALING_CONFIGS="64:none,96:dots,128:dots" \
+    python scripts/run_batch_scaling.py
+
+probe || exit 1
+
+# 5. Hyperband sweep serialized on the chip (redirected, copied back)
+run 5400 hyperband_tpu env SWEEP_PLATFORM=axon KATIB_ARTIFACTS_DIR="$ART" \
+    python scripts/run_hyperband_sweep.py
+[ -f "$ART/hyperband/sweep_summary.json" ] && \
+    cp "$ART/hyperband/sweep_summary.json" artifacts/hyperband/sweep_summary_tpu.json
+
+probe || exit 1
+
+# 6. op microbench: two-point dispatch/marginal fit + unroll atoms
+run 3600 op_microbench python scripts/run_op_microbench.py
+
+probe || exit 1
+
+# 7. full-step scan-unroll A/B (two fresh terminal compiles; keep last)
+run 7200 scan_unroll_ab env UNROLL_FACTORS=1,2 BENCH_RETRIES=2 \
+    python scripts/run_scan_unroll_ab.py
+
+probe || exit 1
+
+# 8. paper-protocol augment: one step timed at 20 cells (fit-proof gated
+#    inside the harness), 600-epoch accounting — redirected + copied back
+run 5400 augment_20cell env AUGMENT_LAYERS=20 AUGMENT_CHANNELS=36 \
+    AUGMENT_EPOCHS=1 AUGMENT_ACCOUNT_EPOCHS=600 \
+    KATIB_ARTIFACTS_DIR="$ART" python scripts/run_augment_tpu.py
+for f in augment_tpu augment_aot; do
+    [ -f "$ART/flagship/$f.json" ] && \
+        cp "$ART/flagship/$f.json" "artifacts/flagship/${f}_20cell.json"
+done
+
+probe || exit 1
+
+# 9. real-data on-chip runs carried from window4
+run 3600 nas_digits env DEMO_PLATFORM=axon KATIB_ARTIFACTS_DIR="$ART" \
+    python scripts/run_nas_real_data.py
+[ -f "$ART/real_data/digits_nas.json" ] && \
+    cp "$ART/real_data/digits_nas.json" artifacts/real_data/digits_nas_tpu.json
+
+probe || exit 1
+
+run 3600 enas_digits env ENAS_PLATFORM=axon ENAS_DATASET=digits \
+    KATIB_ARTIFACTS_DIR="$ART" python scripts/run_enas_demo.py
+[ -f "$ART/enas/digits_summary.json" ] && \
+    cp "$ART/enas/digits_summary.json" artifacts/enas/digits_summary_tpu.json
+
+probe || exit 1
+
+run 3600 pbt_digits env PBT_PLATFORM=axon PBT_DATASET=digits \
+    PBT_GENERATIONS=6 KATIB_ARTIFACTS_DIR="$ART" \
+    python scripts/run_pbt_demo.py
+[ -f "$ART/pbt/digits_summary.json" ] && \
+    cp "$ART/pbt/digits_summary.json" artifacts/pbt/digits_summary_tpu.json
+
+probe || exit 1
+
+# 10. closing live bench: fresh on-chip memo + warm terminal cache so the
+#     driver's end-of-round run completes live
+run 5400 bench_final env BENCH_RETRIES=2 python bench.py
+
+echo "=== window5 complete $(date -u +%F' '%T)" | tee -a "$LOG/driver.log"

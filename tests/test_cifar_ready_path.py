@@ -147,3 +147,88 @@ def test_enas_demo_real_cifar_path(fake_cifar_dir):
         summary = json.load(f)
     assert summary["dataset"] == "cifar10"
     assert summary["real_data"] is True
+
+
+@pytest.mark.slow
+def test_flagship_progress_stream_rewrite_keeps_other_tags(fake_cifar_dir, tmp_path):
+    """ADVICE r4 (medium): a fresh run must rewrite the shared
+    run_progress.jsonl keeping OTHER configs' records — not whole-file
+    truncate keyed off the last line — and must drop its OWN tag's stale
+    records so repeated fresh runs can't concatenate duplicate epoch
+    series under one tag.
+
+    Scenario from the finding: config A runs; config B (a smoke run)
+    appends; a SECOND fresh B run starts.  The old guard saw last-tag==B
+    and truncated everything, erasing A's evidence; the rewrite must keep
+    A's records and replace only B's."""
+    common = {
+        "KATIB_DATA_DIR": fake_cifar_dir,
+        "KATIB_DATASET": "cifar10",
+        "FLAGSHIP_SMALL": "1",
+        "FLAGSHIP_EPOCHS": "1",
+        "FLAGSHIP_NTRAIN": "64",
+        "JAX_PLATFORMS": "cpu",
+        "KATIB_ARTIFACTS_DIR": fake_cifar_dir,
+        "FLAGSHIP_EPOCH_DEADLINE": "0",
+    }
+
+    def stream():
+        with open(os.path.join(fake_cifar_dir, "flagship", "run_progress.jsonl")) as f:
+            return [json.loads(ln) for ln in f if ln.strip()]
+
+    # run A (batch 8), then B (batch 16), then B again — all fresh runs
+    _run("run_flagship_tpu.py", {**common, "FLAGSHIP_BATCH": "8",
+                                 "FLAGSHIP_CKPT": str(tmp_path / "ckptA")})
+    recs = stream()
+    tag_a = recs[-1]["config"]
+    _run("run_flagship_tpu.py", {**common, "FLAGSHIP_BATCH": "16",
+                                 "FLAGSHIP_CKPT": str(tmp_path / "ckptB")})
+    recs = stream()
+    tag_b = recs[-1]["config"]
+    assert tag_b != tag_a
+    assert [r["config"] for r in recs] == [tag_a, tag_b]
+    _run("run_flagship_tpu.py", {**common, "FLAGSHIP_BATCH": "16",
+                                 "FLAGSHIP_CKPT": str(tmp_path / "ckptB2")})
+    recs = stream()
+    # A's evidence survived; B has exactly ONE series (no duplicates)
+    assert [r["config"] for r in recs] == [tag_a, tag_b]
+    assert [r["epoch"] for r in recs if r["config"] == tag_b] == [0]
+
+
+@pytest.mark.slow
+def test_flagship_watchdog_stall_exit75_then_resume(fake_cifar_dir, tmp_path):
+    """VERDICT r4 weak-5: the stall watchdog + resume outer loop, exercised
+    in anger (not just asserted).  A stall injected after epoch 0's
+    snapshot must exit 75 (resume-safe); a plain relaunch must resume from
+    the snapshot and complete with the FULL history."""
+    env = dict(os.environ)
+    common = {
+        "KATIB_DATA_DIR": fake_cifar_dir,
+        "KATIB_DATASET": "cifar10",
+        "FLAGSHIP_SMALL": "1",
+        "FLAGSHIP_EPOCHS": "3",
+        "FLAGSHIP_BATCH": "8",
+        "FLAGSHIP_NTRAIN": "64",
+        "JAX_PLATFORMS": "cpu",
+        "KATIB_ARTIFACTS_DIR": fake_cifar_dir,
+        "FLAGSHIP_CKPT": str(tmp_path / "ckpt"),
+    }
+    env.update(common)
+    env.update(
+        FLAGSHIP_EPOCH_DEADLINE="2", FLAGSHIP_TEST_STALL_AFTER_EPOCH="0"
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "run_flagship_tpu.py")],
+        capture_output=True, text=True, env=env, timeout=900, cwd=REPO,
+    )
+    assert proc.returncode == 75, proc.stdout[-2000:] + proc.stderr[-1000:]
+    assert "WATCHDOG" in proc.stdout
+    assert os.path.isdir(tmp_path / "ckpt")  # snapshot survived the kill
+
+    # relaunch (the queue's retry step): resumes, completes, full history
+    _run("run_flagship_tpu.py", {**common, "FLAGSHIP_EPOCH_DEADLINE": "900"})
+    with open(os.path.join(fake_cifar_dir, "flagship", "run_log.json")) as f:
+        log = json.load(f)
+    epochs = [h["epoch"] for h in log["accuracy_vs_wallclock"]]
+    assert epochs == [0, 1, 2]  # resumed history merged, no gaps
+    assert not os.path.isdir(tmp_path / "ckpt")  # cleaned after completion
