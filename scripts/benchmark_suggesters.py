@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import json
 import os
+import statistics
 import sys
 import time
 
@@ -195,11 +196,18 @@ def main() -> int:
     for r in results:
         if "best" in r:
             summary.setdefault((r["algorithm"], r["objective"]), []).append(r["best"])
+    # variance columns (the promotion-noise method, run_promotion_noise.py
+    # — VERDICT r4 item 7): seed spread alongside the median so a
+    # high-variance "win" cannot masquerade as a robust one
     table = [
         {
             "algorithm": a,
             "objective": o,
             "median_best": sorted(v)[len(v) // 2],
+            "best_stdev_across_seeds": (
+                round(statistics.stdev(v), 6) if len(v) > 1 else None
+            ),
+            "best_range_across_seeds": [min(v), max(v)],
             "seeds": len(v),
         }
         for (a, o), v in sorted(summary.items())

@@ -206,8 +206,11 @@ def part_b(small: bool) -> dict:
                     "epochs", ParameterType.INT, FeasibleSpace(min=1, max=r_l)
                 ),
             ],
+            # hyperband's rung-0 bracket width is r_l wide at eta=4; the
+            # suggester refuses parallelism below it (run_hyperband_sweep
+            # uses 16 for the same reason)
             max_trial_count=max_trials,
-            parallel_trial_count=8,
+            parallel_trial_count=max(16, r_l),
             train_fn=train,
         )
         alloc = SliceAllocator(slice_size=1, devices=jax.devices())
