@@ -121,12 +121,18 @@ def main() -> int:
     # evidence, no matter what order runs interleave in) and drops only OUR
     # tag's stale records (so repeated fresh runs can't concatenate
     # duplicate epoch series under one tag).
-    def _keep_other_tags() -> list[str]:
+    def _keep_other_tags() -> list[str] | None:
+        """Records to preserve through the rewrite, or None when the
+        existing stream could not be READ — a transient read failure must
+        downgrade to append-only, never to a truncating write that would
+        erase other configs' evidence."""
+        if not os.path.exists(progress_path):
+            return []
         try:
             with open(progress_path) as f:
                 lines = [ln for ln in f if ln.strip()]
         except OSError:
-            return []
+            return None
         kept = []
         for ln in lines:
             try:
@@ -178,9 +184,6 @@ def main() -> int:
 
     def report(epoch, accuracy, loss):
         beat[0] = time.perf_counter()
-        if stall_after is not None and epoch == int(stall_after):
-            print(f"flagship: TEST STALL injected after epoch {epoch}", flush=True)
-            time.sleep(10 * deadline if deadline > 0 else 3600)
         now = time.perf_counter()
         epoch_times.append(now - last[0])
         last[0] = now
@@ -192,12 +195,18 @@ def main() -> int:
         try:
             if rewrite_first[0]:
                 kept = _keep_other_tags()
-                with open(progress_path, "w") as f:
-                    # only a successful open consumes the rewrite — a
-                    # transient OSError must not flip later epochs of a
-                    # fresh run into appending after stale same-tag records
+                if kept is None:
+                    # stream exists but is unreadable: appending may leave
+                    # stale same-tag records, but truncating could erase
+                    # other configs' evidence — append wins
                     rewrite_first[0] = False
-                    f.writelines(kept)
+                else:
+                    with open(progress_path, "w") as f:
+                        # only a successful open consumes the rewrite — a
+                        # transient OSError must not flip later epochs of
+                        # a fresh run into appending after stale records
+                        rewrite_first[0] = False
+                        f.writelines(kept)
             with open(progress_path, "a") as f:
                 f.write(
                     json.dumps(
@@ -215,6 +224,12 @@ def main() -> int:
                 )
         except OSError:
             pass
+        if stall_after is not None and epoch == int(stall_after):
+            # after the snapshot AND the stream record have landed — the
+            # real wedge stalls in the NEXT epoch's dispatch, so the
+            # injected hang must not swallow this epoch's evidence
+            print(f"flagship: TEST STALL injected after epoch {epoch}", flush=True)
+            time.sleep(10 * deadline if deadline > 0 else 3600)
         return True
 
     t0 = time.perf_counter()

@@ -51,9 +51,25 @@ sys.path.insert(0, REPO)
 import numpy as np  # noqa: E402
 
 
+def _avg_ranks(x: list[float]) -> np.ndarray:
+    """Average ranks for ties (scipy.stats.rankdata semantics) — digits
+    accuracies quantize to multiples of 1/n_test, so ties are routine and
+    arbitrary distinct ranks would bias the correlation."""
+    arr = np.asarray(x, dtype=float)
+    order = np.argsort(arr, kind="stable")
+    ranks = np.empty(len(arr), dtype=float)
+    i = 0
+    while i < len(arr):
+        j = i
+        while j + 1 < len(arr) and arr[order[j + 1]] == arr[order[i]]:
+            j += 1
+        ranks[order[i : j + 1]] = (i + j) / 2.0
+        i = j + 1
+    return ranks
+
+
 def spearman(a: list[float], b: list[float]) -> float:
-    ra = np.argsort(np.argsort(a)).astype(float)
-    rb = np.argsort(np.argsort(b)).astype(float)
+    ra, rb = _avg_ranks(a), _avg_ranks(b)
     if np.std(ra) == 0 or np.std(rb) == 0:
         return 0.0
     return float(np.corrcoef(ra, rb)[0, 1])
