@@ -1,0 +1,97 @@
+"""Device-side image augmentation for the DARTS augment phase.
+
+The reference trial image trains CIFAR-10 with RandomCrop(32, padding=4) +
+RandomHorizontalFlip + Cutout(16) on the host dataloader
+(``examples/v1beta1/trial-images/darts-cnn-cifar10/utils.py:15-30``) — the
+transforms the paper's ~97% depends on.  Rebuilding them host-side would
+reintroduce the per-step host->device transfer the ``device_data`` epoch
+scan exists to avoid, so these are **jittable batch transforms** that run
+inside the scan body on the accelerator:
+
+- static output shapes (pad -> ``dynamic_slice`` crop, mask-multiply
+  cutout) — no data-dependent shapes, so XLA fuses them into the step;
+- per-sample randomness from a single folded PRNG key, split per batch by
+  the caller (``train_classifier``'s scan body folds the training step
+  counter into an epoch key, so batch composition AND augmentation are
+  reproducible from the run seed alone).
+
+Everything is pure elementwise/gather work — negligible next to the conv
+stack, and it rides the same one-dispatch-per-epoch economics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+def random_crop_flip(key: jax.Array, x: jax.Array, padding: int = 4) -> jax.Array:
+    """Zero-pad by ``padding`` then crop back to HxW at a per-sample random
+    offset, plus a per-sample horizontal flip — the reference's
+    RandomCrop(32, padding=4) + RandomHorizontalFlip."""
+    b, h, w, c = x.shape
+    padded = jnp.pad(
+        x, ((0, 0), (padding, padding), (padding, padding), (0, 0))
+    )
+    k_y, k_x, k_f = jax.random.split(key, 3)
+    off_y = jax.random.randint(k_y, (b,), 0, 2 * padding + 1)
+    off_x = jax.random.randint(k_x, (b,), 0, 2 * padding + 1)
+
+    def crop_one(img, oy, ox):
+        return jax.lax.dynamic_slice(img, (oy, ox, 0), (h, w, c))
+
+    x = jax.vmap(crop_one)(padded, off_y, off_x)
+    flip = jax.random.bernoulli(k_f, 0.5, (b,))
+    return jnp.where(flip[:, None, None, None], x[:, :, ::-1, :], x)
+
+
+def cutout(key: jax.Array, x: jax.Array, length: int = 16) -> jax.Array:
+    """Zero a length x length square at a per-sample random center — the
+    reference's Cutout(length=16) (``utils.py:33-52``), as a static-shape
+    mask multiply (the square clips at the borders, like the original)."""
+    b, h, w, _ = x.shape
+    k_y, k_x = jax.random.split(key)
+    cy = jax.random.randint(k_y, (b,), 0, h)
+    cx = jax.random.randint(k_x, (b,), 0, w)
+    ys = jnp.arange(h)[None, :, None]
+    xs = jnp.arange(w)[None, None, :]
+    # half-open [c-half, c+half): exactly `length` rows/cols, matching the
+    # reference's y1=y-half..y2=y+half slice semantics
+    half = length // 2
+    dy = ys - cy[:, None, None]
+    dx = xs - cx[:, None, None]
+    inside = (dy >= -half) & (dy < half) & (dx >= -half) & (dx < half)
+    return jnp.where(inside[..., None], jnp.zeros((), x.dtype), x)
+
+
+def cifar_train_augment(
+    key: jax.Array, x: jax.Array, *, padding: int = 4, cutout_length: int = 16
+) -> jax.Array:
+    """The reference's full CIFAR-10 train-time pipeline: crop + flip +
+    cutout.  Use as ``train_classifier(..., augment_fn=cifar_train_augment)``."""
+    k_crop, k_cut = jax.random.split(key)
+    x = random_crop_flip(k_crop, x, padding=padding)
+    return cutout(k_cut, x, length=cutout_length)
+
+
+@dataclasses.dataclass(frozen=True)
+class CifarAugment:
+    """Value-hashable augment_fn: two instances with the same parameters
+    hash and compare equal, so the trainer's jit-step cache reuses one
+    compiled epoch across trials even when each trial constructs its own
+    instance (a functools.partial would key by identity and force a
+    recompile per trial)."""
+
+    padding: int = 4
+    cutout_length: int = 16
+
+    def __call__(self, key: jax.Array, x: jax.Array) -> jax.Array:
+        return cifar_train_augment(
+            key, x, padding=self.padding, cutout_length=self.cutout_length
+        )
+
+
+def make_cifar_augment(padding: int = 4, cutout_length: int = 16) -> CifarAugment:
+    return CifarAugment(padding=padding, cutout_length=cutout_length)

@@ -116,7 +116,7 @@ _STEP_CACHE_MAX = 32
 _STEP_CACHE_LOCK = threading.Lock()
 
 
-def _build_steps(model: nn.Module, optimizer: str, mesh):
+def _build_steps(model: nn.Module, optimizer: str, mesh, augment_fn=None):
     def loss_fn(params, batch):
         x, y = batch
         return cross_entropy_loss(model.apply(params, x), y)
@@ -133,15 +133,26 @@ def _build_steps(model: nn.Module, optimizer: str, mesh):
     step = make_train_step(loss_fn, tx, mesh)
     evaluate = make_eval_step(metric_fn, mesh)
 
-    def _epoch(state, x, y, ix):
+    # train-time augmentation runs INSIDE the scan body (device-side, one
+    # fold of the step counter per batch) so the host->device path the
+    # device_data scan removed never comes back for augmented runs
+    def _epoch(state, x, y, ix, akey):
         def body(s, i):
-            s, m = step(s, (x[i], y[i]))
+            xb = x[i]
+            if augment_fn is not None:
+                xb = augment_fn(jax.random.fold_in(akey, s.step), xb)
+            s, m = step(s, (xb, y[i]))
             return s, m["loss"]
 
         return jax.lax.scan(body, state, ix)
 
     scan_epoch = jax.jit(_epoch, donate_argnums=(0,))
-    return tx, step, evaluate, scan_epoch
+    # jitted per-batch augment for the streamed path, built (and cached)
+    # alongside the steps so concurrent trials share one trace
+    aug_step = (
+        jax.jit(lambda k, xb: augment_fn(k, xb)) if augment_fn is not None else None
+    )
+    return tx, step, evaluate, scan_epoch, aug_step
 
 
 def _mesh_key(mesh):
@@ -156,17 +167,20 @@ def _mesh_key(mesh):
     )
 
 
-def _steps_for(model: nn.Module, optimizer: str, mesh):
+def _steps_for(model: nn.Module, optimizer: str, mesh, augment_fn=None):
     try:
-        key = (hash(model), model, optimizer, _mesh_key(mesh))
+        # augment_fn keys by identity: pass a module-level function (e.g.
+        # augmentation.cifar_train_augment), not a fresh lambda per call,
+        # or every trial recompiles
+        key = (hash(model), model, optimizer, _mesh_key(mesh), augment_fn)
     except TypeError:
-        return _build_steps(model, optimizer, mesh)
+        return _build_steps(model, optimizer, mesh, augment_fn)
     with _STEP_CACHE_LOCK:
         built = _STEP_CACHE.get(key)
     if built is None:
         # build OUTSIDE the lock (tracing is slow); a concurrent duplicate
         # build is harmless — setdefault keeps exactly one
-        fresh = _build_steps(model, optimizer, mesh)
+        fresh = _build_steps(model, optimizer, mesh, augment_fn)
         with _STEP_CACHE_LOCK:
             built = _STEP_CACHE.setdefault(key, fresh)
     with _STEP_CACHE_LOCK:
@@ -193,6 +207,7 @@ def train_classifier(
     init_transform=None,
     on_finish=None,
     device_data: bool | None = None,
+    augment_fn=None,
 ) -> float:
     """Train and return final test accuracy; calls ``report(epoch, acc, loss)``
     per epoch when given (the trial metrics hook).
@@ -205,7 +220,13 @@ def train_classifier(
     overrides): train split lives in device memory for the whole run and
     each epoch is ONE jitted ``lax.scan`` with on-device batch gather from
     permutation indices — same transport-only optimization, same
-    batch-composition guarantee as ``nas/darts/search.py``."""
+    batch-composition guarantee as ``nas/darts/search.py``.
+
+    ``augment_fn(key, x) -> x``: jittable train-time batch augmentation
+    (e.g. ``models.augmentation.cifar_train_augment``), applied inside the
+    epoch scan (device-side) or per streamed batch; keyed off the run
+    seed + global step, so augmented runs stay reproducible.  Pass a
+    module-level function — identity keys the jit-step cache."""
     rng = np.random.default_rng(seed)
     params = model.init(
         jax.random.PRNGKey(seed), jnp.zeros((1, *dataset.input_shape), jnp.float32)
@@ -213,7 +234,15 @@ def train_classifier(
     if init_transform is not None:
         # warm starts (e.g. ENAS weight sharing overlays the shared pool)
         params = init_transform(params)
-    tx, step, evaluate, cached_scan_epoch = _steps_for(model, optimizer, mesh)
+    tx, step, evaluate, cached_scan_epoch, aug_step = _steps_for(
+        model, optimizer, mesh, augment_fn
+    )
+    # augmentation randomness: independent of the shuffle stream, folded
+    # with the GLOBAL step in both execution paths (scan folds
+    # TrainState.step in-body; the streamed loop mirrors it with a running
+    # counter), so the same seed draws the same augmentations regardless
+    # of device_data mode
+    aug_key = jax.random.PRNGKey(seed + 0x5EED)
     state = TrainState.create(params, tx)
     # lr/momentum are runtime values inside opt_state (compile-once sweeps)
     state = state._replace(
@@ -265,6 +294,7 @@ def train_classifier(
         ebatch = jax.device_put((xe, ye))
 
     test_acc = 0.0
+    global_step = 0  # mirrors TrainState.step for the streamed aug keying
     for epoch in range(epochs):
         if scan_epoch is not None:
             # same rng draw as batches() below: one permutation per epoch
@@ -275,6 +305,7 @@ def train_classifier(
                 xd,
                 yd,
                 jnp.asarray(idx.reshape(scan_steps, batch_size), jnp.int32),
+                aug_key,
             )
             n = scan_steps
             train_loss = float(jnp.sum(losses))
@@ -285,7 +316,19 @@ def train_classifier(
             step_losses = []
             for xb, yb in batches(dataset.x_train, dataset.y_train, batch_size, rng):
                 batch = (xb, yb) if mesh is None else shard_batch((xb, yb), mesh)
+                if aug_step is not None:
+                    # augment AFTER sharding (elementwise + per-sample
+                    # gathers partition cleanly along the batch axis — no
+                    # default-device round-trip), keyed off the same
+                    # global step the scan path folds
+                    batch = (
+                        aug_step(
+                            jax.random.fold_in(aug_key, global_step), batch[0]
+                        ),
+                        batch[1],
+                    )
                 state, metrics = step(state, batch)
+                global_step += 1
                 step_losses.append(metrics["loss"])
             n = len(step_losses)
             train_loss = float(np.sum(jax.device_get(step_losses))) if n else 0.0

@@ -115,8 +115,16 @@ def train_genotype(
     batch_size: int = 96,
     mesh=None,
     report=None,
+    data_augment: bool = False,
 ) -> float:
-    """Train the discrete network; returns final held-out accuracy."""
+    """Train the discrete network; returns final held-out accuracy.
+
+    ``data_augment``: apply the reference trial image's CIFAR train-time
+    pipeline (RandomCrop(pad 4) + flip + Cutout(16),
+    ``darts-cnn-cifar10/utils.py:15-30``) as device-side batch transforms
+    (``models/augmentation.py``) — the transforms the paper's ~97% augment
+    protocol depends on.  Off by default so throughput artifacts stay
+    comparable across rounds; the accuracy-focused runs opt in."""
     from katib_tpu.models.mnist import train_classifier
 
     from katib_tpu.parallel.mesh import needs_safe_conv
@@ -129,6 +137,11 @@ def train_genotype(
         stem_multiplier=stem_multiplier,
         safe_conv=needs_safe_conv(mesh),
     )
+    augment_fn = None
+    if data_augment:
+        from katib_tpu.models.augmentation import cifar_train_augment
+
+        augment_fn = cifar_train_augment
     return train_classifier(
         net,
         dataset,
@@ -138,4 +151,5 @@ def train_genotype(
         optimizer="momentum",
         mesh=mesh,
         report=report,
+        augment_fn=augment_fn,
     )

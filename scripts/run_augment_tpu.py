@@ -333,6 +333,10 @@ def main() -> int:
         print(f"augment: epoch={epoch} acc={accuracy:.4f}", flush=True)
         return True
 
+    # AUGMENT_DATA_AUG=1: the reference's crop/flip/cutout pipeline as
+    # device-side transforms (models/augmentation.py) — opt-in so the
+    # throughput series stays comparable with earlier rounds
+    data_augment = parse_bool(os.environ.get("AUGMENT_DATA_AUG"))
     final_acc = train_genotype(
         genotype,
         dataset,
@@ -341,6 +345,7 @@ def main() -> int:
         epochs=epochs,
         batch_size=batch,
         report=report,
+        data_augment=data_augment,
     )
 
     # ---- north-star accounting with MEASURED rates
@@ -372,6 +377,7 @@ def main() -> int:
             "layers": layers,
             "batch": batch,
             "epochs_run": epochs,
+            "data_augment": data_augment,
         },
         "step_secs": round(step_secs, 5),
         "images_per_sec": round(img_per_sec, 1),

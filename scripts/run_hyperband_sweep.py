@@ -200,7 +200,10 @@ def main() -> int:
         summary["devices_by_rung"] = dict(sorted(devices_by_rung.items()))
     write_artifact(
         "hyperband",
-        "elastic_summary.json" if elastic else "sweep_summary.json",
+        # NOT elastic_summary.json — that name belongs to run_elastic_ab's
+        # fixed-vs-elastic A/B artifact and must not be clobbered by an
+        # elastic-variant sweep run
+        "sweep_summary_elastic.json" if elastic else "sweep_summary.json",
         summary,
     )
     print(json.dumps({k: summary[k] for k in (

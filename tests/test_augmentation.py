@@ -1,0 +1,141 @@
+"""Device-side augmentation transforms (models/augmentation.py): the
+reference trial image's CIFAR train pipeline (crop/flip/cutout,
+``darts-cnn-cifar10/utils.py:15-52``) rebuilt as jittable batch ops."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from katib_tpu.models.augmentation import (
+    cifar_train_augment,
+    cutout,
+    make_cifar_augment,
+    random_crop_flip,
+)
+
+
+@pytest.fixture()
+def batch():
+    key = jax.random.PRNGKey(0)
+    return jax.random.uniform(key, (4, 16, 16, 3), jnp.float32, 0.1, 1.0)
+
+
+class TestTransforms:
+    def test_shapes_and_dtype_preserved(self, batch):
+        key = jax.random.PRNGKey(1)
+        for fn in (random_crop_flip, cutout, cifar_train_augment):
+            out = fn(key, batch)
+            assert out.shape == batch.shape
+            assert out.dtype == batch.dtype
+
+    def test_deterministic_per_key(self, batch):
+        k = jax.random.PRNGKey(2)
+        a = cifar_train_augment(k, batch)
+        b = cifar_train_augment(k, batch)
+        assert jnp.array_equal(a, b)
+        c = cifar_train_augment(jax.random.PRNGKey(3), batch)
+        assert not jnp.array_equal(a, c)
+
+    def test_crop_introduces_only_pad_zeros(self, batch):
+        # inputs are strictly positive, so any zero must come from the
+        # pad border sliding into view — and non-zeros must be original
+        # pixel values (possibly mirrored)
+        out = random_crop_flip(jax.random.PRNGKey(4), batch, padding=4)
+        vals = np.asarray(out).ravel()
+        src = set(np.round(np.asarray(batch).ravel(), 6).tolist()) | {0.0}
+        assert set(np.round(vals, 6).tolist()) <= src
+
+    def test_cutout_zeroes_bounded_square(self, batch):
+        out = cutout(jax.random.PRNGKey(5), batch, length=8)
+        zeros_per_img = (np.asarray(out) == 0).all(axis=-1).sum(axis=(1, 2))
+        # clipped at borders: between (length/2)^2 and length^2 pixels
+        assert (zeros_per_img >= 16).all()
+        assert (zeros_per_img <= 64).all()
+
+    def test_jit_compatible_inside_scan(self, batch):
+        def epoch(x0, keys):
+            def body(c, k):
+                return cifar_train_augment(k, c), None
+
+            return jax.lax.scan(body, x0, keys)[0]
+
+        keys = jax.random.split(jax.random.PRNGKey(6), 3)
+        out = jax.jit(epoch)(batch, keys)
+        assert out.shape == batch.shape
+
+
+class TestTrainerIntegration:
+    def test_train_classifier_with_augment_fn(self):
+        from katib_tpu.models.data import load_named_dataset
+        from katib_tpu.models.mnist import SmallCNN, train_classifier
+
+        ds = load_named_dataset("digits", 128, 64)
+        aug = make_cifar_augment(padding=1, cutout_length=2)
+        acc = train_classifier(
+            SmallCNN(channels=8),
+            ds,
+            lr=0.05,
+            epochs=1,
+            batch_size=32,
+            augment_fn=aug,
+            eval_batch=64,
+        )
+        assert 0.0 <= acc <= 1.0
+
+    def test_genotype_augment_flag(self):
+        from katib_tpu.models.data import load_named_dataset
+        from katib_tpu.nas.darts.augment import train_genotype
+        from katib_tpu.nas.darts.model import Genotype
+
+        gene = (
+            (("skip_connection", 0), ("separable_convolution_3x3", 1)),
+            (("max_pooling_3x3", 0), ("skip_connection", 2)),
+        )
+        genotype = Genotype(normal=gene, reduce=gene)
+        ds = load_named_dataset("digits", 96, 48)
+        acc = train_genotype(
+            genotype,
+            ds,
+            init_channels=4,
+            num_layers=2,
+            epochs=1,
+            batch_size=32,
+            data_augment=True,
+        )
+        assert 0.0 <= acc <= 1.0
+
+
+class TestCacheAndReproducibility:
+    def test_augment_fn_value_hashable(self):
+        # two instances with equal params must share one step-cache entry
+        assert make_cifar_augment(2, 4) == make_cifar_augment(2, 4)
+        assert hash(make_cifar_augment(2, 4)) == hash(make_cifar_augment(2, 4))
+        assert make_cifar_augment(2, 4) != make_cifar_augment(2, 8)
+
+    def test_cutout_exact_square_when_unclipped(self):
+        x = jnp.ones((1, 32, 32, 1))
+        sizes = set()
+        for s in range(50):
+            o = np.asarray(cutout(jax.random.PRNGKey(s), x, length=16))
+            sizes.add(int((o == 0).sum()))
+        # reference Cutout zeroes a length x length patch, border-clipped:
+        # the unclipped case must appear and must be exactly 256 pixels
+        assert max(sizes) == 256, sizes
+
+    def test_scan_and_streamed_paths_draw_same_augmentations(self):
+        from katib_tpu.models.data import load_named_dataset
+        from katib_tpu.models.mnist import SmallCNN, train_classifier
+
+        ds = load_named_dataset("digits", 128, 64)
+        aug = make_cifar_augment(padding=1, cutout_length=2)
+        accs = [
+            train_classifier(
+                SmallCNN(channels=8), ds, lr=0.05, epochs=2, batch_size=32,
+                augment_fn=aug, eval_batch=64, device_data=dd,
+            )
+            for dd in (True, False)
+        ]
+        assert accs[0] == accs[1]  # same seed => identical run in both modes
