@@ -77,6 +77,7 @@ def run_darts_search(
     device_data: bool | None = None,
     fused: bool = False,
     scan_unroll: int | None = None,
+    augment_fn=None,
 ) -> dict[str, Any]:
     """Run the bilevel architecture search; returns genotype + final metrics.
 
@@ -201,6 +202,24 @@ def run_darts_search(
             else parse_bool(env)
         )
     # scan_steps is the true per-epoch step count (steps_per_epoch above is
+    # Search-phase train-time augmentation (reference trains the search on
+    # transformed CIFAR — crop+flip, run_trial.py:98-111 via
+    # utils.get_dataset; cutout is augment-phase only).  Opt in with the
+    # augment_fn parameter, or KATIB_SEARCH_AUG=1 for the default
+    # crop+flip.  Applied to the w-split batch in BOTH epoch paths (scan
+    # and streamed/mesh), keyed off SearchState.step so the stream is
+    # reproducible from the seed and survives resume.  Default-off: it
+    # changes the compiled epoch program, so the flagship's terminal-cache
+    # and resume compatibility within a round are preserved.
+    if augment_fn is None and parse_bool(os.environ.get("KATIB_SEARCH_AUG")):
+        from katib_tpu.models.augmentation import random_crop_flip
+
+        augment_fn = random_crop_flip
+    aug_key = jax.random.PRNGKey(seed + 0x5EED)
+    aug_step = (
+        jax.jit(lambda k, xb: augment_fn(k, xb)) if augment_fn is not None else None
+    )
+
     # clamped to >=1 for the lr schedule even when the split is smaller than
     # one batch — the streamed path then just yields zero batches)
     scan_steps = len(x_w) // batch_size
@@ -225,7 +244,10 @@ def run_darts_search(
         def _epoch(state, xw, yw, xa, ya, w_ix, a_ix):
             def body(s, ix):
                 wi, ai = ix
-                s, m = search_step(s, (xw[wi], yw[wi]), (xa[ai], ya[ai]))
+                xb = xw[wi]
+                if augment_fn is not None:
+                    xb = augment_fn(jax.random.fold_in(aug_key, s.step), xb)
+                s, m = search_step(s, (xb, yw[wi]), (xa[ai], ya[ai]))
                 return s, m["train_loss"]
 
             return jax.lax.scan(
@@ -360,6 +382,15 @@ def run_darts_search(
                 for wb, ab in zip(w_stream, a_stream):
                     if mesh is not None:
                         wb, ab = shard_batch(wb, mesh), shard_batch(ab, mesh)
+                    if aug_step is not None:
+                        # after sharding (partitions along batch) and keyed
+                        # off the SAME SearchState.step the scan path folds
+                        wb = (
+                            aug_step(
+                                jax.random.fold_in(aug_key, state.step), wb[0]
+                            ),
+                            wb[1],
+                        )
                     state, metrics = search_step(state, wb, ab)
                     step_losses.append(metrics["train_loss"])
                 steps = len(step_losses)

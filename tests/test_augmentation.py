@@ -139,3 +139,30 @@ class TestCacheAndReproducibility:
             for dd in (True, False)
         ]
         assert accs[0] == accs[1]  # same seed => identical run in both modes
+
+
+def test_search_phase_augmentation_changes_training(monkeypatch):
+    """KATIB_SEARCH_AUG=1 applies crop+flip to the w-split inside the
+    bilevel epoch (reference search phase trains on transformed CIFAR;
+    cutout stays augment-phase-only).  Load-bearing: the augmented run
+    must diverge from the unaugmented one, in BOTH epoch paths."""
+    from katib_tpu.models.data import load_named_dataset
+    from katib_tpu.nas.darts.search import run_darts_search
+
+    ds = load_named_dataset("digits", 96, 48)
+
+    def run(aug, dd):
+        if aug:
+            monkeypatch.setenv("KATIB_SEARCH_AUG", "1")
+        else:
+            monkeypatch.delenv("KATIB_SEARCH_AUG", raising=False)
+        monkeypatch.setenv("KATIB_DEVICE_DATA", "1" if dd else "0")
+        out = run_darts_search(
+            ds, num_layers=2, init_channels=4, n_nodes=2,
+            num_epochs=1, batch_size=16, seed=0,
+        )
+        return out["history"][-1]["train_loss"]
+
+    base = run(aug=False, dd=True)
+    assert run(aug=True, dd=True) != base  # scan path actually augments
+    assert run(aug=True, dd=False) != run(aug=False, dd=False)  # streamed too
