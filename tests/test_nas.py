@@ -578,7 +578,7 @@ class TestDeviceDataSearch:
         # optimizer is bit-identical to the plain one — asserted elsewhere)
         assert accs[0] > 0.4
         assert len(M._STEP_CACHE) == 1  # both trials hit one cache entry
-        _tx, step, _ev, scan_epoch = next(iter(M._STEP_CACHE.values()))
+        _tx, step, _ev, scan_epoch, _aug = next(iter(M._STEP_CACHE.values()))
         traced = scan_epoch._cache_size() + step._cache_size()
         assert traced == 1, f"expected exactly one trace total, got {traced}"
 
