@@ -135,6 +135,14 @@ def _build_flagship(jax, jnp):
     # instead of 6+6; nas/darts/fused.py) — the measured attack on the
     # small-op-bound 0.56% MFU profile
     fused = parse_bool(os.environ.get("BENCH_FUSED"))
+    # BENCH_PAIRED_HESSIAN=1: the two finite-difference passes run as one
+    # vmapped pass over stacked (w+, w-) — 4 sequential network passes per
+    # bilevel step instead of 5 (architect.py DartsHyper.paired_hessian).
+    # Math parity is f32-gated in tests; in bf16 the variants differ at
+    # rounding level (the finite difference amplifies decorrelated
+    # rounding), so this is an A/B-able throughput config, not a bitwise
+    # twin.
+    paired = parse_bool(os.environ.get("BENCH_PAIRED_HESSIAN"))
     net = DartsNetwork(
         primitives=DEFAULT_PRIMITIVES,
         init_channels=INIT_CHANNELS,
@@ -156,7 +164,9 @@ def _build_flagship(jax, jnp):
         xb, yb = batch
         return cross_entropy_loss(net.apply(w, xb, a), yb)
 
-    hyper = DartsHyper(total_steps=max(TIMED_STEPS, 1), unrolled=True)
+    hyper = DartsHyper(
+        total_steps=max(TIMED_STEPS, 1), unrolled=True, paired_hessian=paired
+    )
     step = make_search_step(loss_fn, hyper, mesh=None)
     state = init_search_state(weights, alphas, hyper)
     return step, state, (x, y), net, remat
@@ -253,23 +263,10 @@ def _aot_child() -> None:
                 },
                 "compile_secs": round(compile_secs, 1),
                 "topology_secs": round(topo_secs, 1),
-                "config": {
-                    "batch": BATCH,
-                    "num_layers": NUM_LAYERS,
-                    "init_channels": INIT_CHANNELS,
-                    "small_shapes": _SMALL,
-                    "remat": remat,
-                    **(
-                        {"remat_policy": os.environ["BENCH_REMAT_POLICY"]}
-                        if os.environ.get("BENCH_REMAT_POLICY")
-                        else {}
-                    ),
-                    **(
-                        {"fused": True}
-                        if parse_bool(os.environ.get("BENCH_FUSED"))
-                        else {}
-                    ),
-                },
+                # single source with the memo-key derivation: a child
+                # whose self-report drifted from _aot_expected_config would
+                # silently mis-key the committed memos
+                "config": _aot_expected_config(),
             }
         )
     )
@@ -278,7 +275,8 @@ def _aot_child() -> None:
 def _memo_path(config: dict, stem: str) -> str:
     """Default config memoizes to the committed ``<stem>.json``;
     exploration configs (BENCH_BATCH / BENCH_REMAT / BENCH_REMAT_POLICY /
-    BENCH_FUSED overrides) get their own file so a scaling study can never
+    BENCH_FUSED / BENCH_PAIRED_HESSIAN overrides) get their own file so a
+    scaling study can never
     clobber the artifact the driver's end-of-round bench relies on.  One
     tag builder for BOTH the AOT and on-chip-capture memos, so the two
     can never key differently for the same config."""
@@ -297,6 +295,8 @@ def _memo_path(config: dict, stem: str) -> str:
             tag += f"_{config['remat_policy']}"
         if config.get("fused"):
             tag += "_fused"
+        if config.get("paired_hessian"):
+            tag += "_pairhess"
         name = f"{stem}_{tag}.json"
     return os.path.join(_HERE, "artifacts", "flagship", name)
 
@@ -321,6 +321,8 @@ def _aot_expected_config() -> dict:
         cfg["remat_policy"] = os.environ["BENCH_REMAT_POLICY"]
     if parse_bool(os.environ.get("BENCH_FUSED")):
         cfg["fused"] = True
+    if parse_bool(os.environ.get("BENCH_PAIRED_HESSIAN")):
+        cfg["paired_hessian"] = True
     return cfg
 
 
@@ -603,23 +605,10 @@ def _child() -> None:
                 "compile_secs": round(compile_secs, 1),
                 # self-reported so recorded provenance can never drift from
                 # what actually ran
-                "config": {
-                    "batch": BATCH,
-                    "num_layers": NUM_LAYERS,
-                    "init_channels": INIT_CHANNELS,
-                    "small_shapes": _SMALL,
-                    "remat": remat,
-                    **(
-                        {"remat_policy": os.environ["BENCH_REMAT_POLICY"]}
-                        if os.environ.get("BENCH_REMAT_POLICY")
-                        else {}
-                    ),
-                    **(
-                        {"fused": True}
-                        if parse_bool(os.environ.get("BENCH_FUSED"))
-                        else {}
-                    ),
-                },
+                # single source with the memo-key derivation: a child
+                # whose self-report drifted from _aot_expected_config would
+                # silently mis-key the committed memos
+                "config": _aot_expected_config(),
             }
         )
     )

@@ -50,6 +50,14 @@ class DartsHyper(NamedTuple):
     alpha_weight_decay: float = 1e-3
     total_steps: int = 1000  # for the cosine schedule
     unrolled: bool = True  # second-order (hessian correction) on/off
+    # evaluate the two finite-difference passes (grad_a at w+eps*d and
+    # w-eps*d) as ONE vmapped pass over a stacked weight pytree instead of
+    # two sequential passes.  Same math (parity-gated in tests); the step
+    # drops from 5 sequential network passes to 4 — a designed attack on
+    # the measured overhead-bound profile (0.56% MFU, op_microbench.json)
+    # where arithmetic inside a pass is nearly free but passes are not.
+    # Off by default until the on-chip A/B decides.
+    paired_hessian: bool = False
     # expose the raw second-order alpha gradient in the step metrics —
     # parity gates compare IT rather than the post-Adam alphas (Adam's
     # sign-like first step turns sub-noise gradient elements into full
@@ -104,10 +112,21 @@ def make_search_step(
         # finite-difference Hessian-vector product
         dw_norm = optax.global_norm(dw)
         eps = 0.01 / (dw_norm + 1e-12)
-        w_pos = tmap(lambda p, d: p + eps * d, w, dw)
-        w_neg = tmap(lambda p, d: p - eps * d, w, dw)
-        da_pos = grad_a(w_pos, a, train_batch)
-        da_neg = grad_a(w_neg, a, train_batch)
+        if hyper.paired_hessian:
+            # one vmapped pass over stacked (w+, w-) — see DartsHyper
+            w_pm = tmap(
+                lambda p, d: jnp.stack([p + eps * d, p - eps * d]), w, dw
+            )
+            da_pm = jax.vmap(grad_a, in_axes=(0, None, None))(
+                w_pm, a, train_batch
+            )
+            da_pos = tmap(lambda t: t[0], da_pm)
+            da_neg = tmap(lambda t: t[1], da_pm)
+        else:
+            w_pos = tmap(lambda p, d: p + eps * d, w, dw)
+            w_neg = tmap(lambda p, d: p - eps * d, w, dw)
+            da_pos = grad_a(w_pos, a, train_batch)
+            da_neg = grad_a(w_neg, a, train_batch)
         hessian = tmap(lambda p, n: (p - n) / (2.0 * eps), da_pos, da_neg)
         alpha_grad = tmap(lambda d, h: d - lr * h, da, hessian)
         return alpha_grad, val_loss

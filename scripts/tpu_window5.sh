@@ -105,6 +105,25 @@ for f in augment_tpu augment_aot; do
         cp "$ART/flagship/$f.json" "artifacts/flagship/${f}_20cell.json"
 done
 
+# 8b. augment batch scaling: the 20-cell step again at batch 384 — the
+#     augment phase is the paper protocol's long pole and is
+#     overhead-bound at b96 (1.14% MFU), so batch amortization is the
+#     lever.  Gated on the committed deviceless fit-proof; the harness's
+#     memo file in $ART must carry the b384 proof or it would re-pay the
+#     AOT inside the window.
+if [ -f artifacts/flagship/augment_aot_20cell_b384.json ]; then
+    probe || exit 1
+    cp artifacts/flagship/augment_aot_20cell_b384.json "$ART/flagship/augment_aot.json"
+    # step 8 already wrote $ART/flagship/augment_tpu.json — remove it so a
+    # failed b384 run cannot commit step 8's b96 timing under a b384 name
+    rm -f "$ART/flagship/augment_tpu.json"
+    run 5400 augment_20cell_b384 env AUGMENT_LAYERS=20 AUGMENT_CHANNELS=36 \
+        AUGMENT_BATCH=384 AUGMENT_EPOCHS=1 AUGMENT_ACCOUNT_EPOCHS=600 \
+        KATIB_ARTIFACTS_DIR="$ART" python scripts/run_augment_tpu.py
+    [ -f "$ART/flagship/augment_tpu.json" ] && \
+        cp "$ART/flagship/augment_tpu.json" artifacts/flagship/augment_tpu_20cell_b384.json
+fi
+
 probe || exit 1
 
 # 9. real-data on-chip runs carried from window4

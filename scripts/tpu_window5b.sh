@@ -1,0 +1,60 @@
+#!/usr/bin/env bash
+# Follow-up window queue: waits for tpu_window5.sh's completion marker,
+# then runs the late-breaking A/Bs that landed after window5 started:
+#   1. paired-Hessian bilevel step (BENCH_PAIRED_HESSIAN=1) vs the
+#      committed unfused baseline — 4 network passes per step instead of
+#      5 (architect.py DartsHyper.paired_hessian); gated on the
+#      committed deviceless fit-proof so no unproven compile touches the
+#      terminal
+# Usage: setsid bash scripts/tpu_window5b.sh &   Logs: /tmp/tpu_window5b/
+set -u
+cd "$(dirname "$0")/.."
+LOG=/tmp/tpu_window5b
+mkdir -p "$LOG"
+
+echo "window5b: waiting for window5 completion marker" >"$LOG/driver.log"
+until grep -q "window5 complete" /tmp/tpu_window5/driver.log 2>/dev/null; do
+    sleep 60
+done
+
+probe() {
+    env POOL_WATCH_PROBE_TIMEOUT=180 POOL_WATCH_INTERVAL=120 \
+        POOL_WATCH_MAX_HOURS=6 python scripts/pool_watch.py \
+        >>"$LOG/pool_watch.log" 2>&1
+}
+
+run() {
+    local t=$1 name=$2; shift 2
+    echo "=== $name start $(date -u +%F' '%T)" | tee -a "$LOG/driver.log"
+    setsid "$@" >"$LOG/$name.log" 2>&1 &
+    local pid=$!
+    ( sleep "$t" && kill -- -"$pid" 2>/dev/null && sleep 20 \
+        && kill -9 -- -"$pid" 2>/dev/null ) &
+    local watcher=$!
+    local rc=0
+    wait "$pid" || rc=$?
+    kill "$watcher" 2>/dev/null; wait "$watcher" 2>/dev/null
+    kill -9 -- -"$pid" 2>/dev/null
+    echo "=== $name rc=$rc end $(date -u +%F' '%T)" | tee -a "$LOG/driver.log"
+}
+
+probe || exit 1
+
+# paired-Hessian A/B: only with the committed fit-proof (terminal-crash
+# rule from run_batch_scaling.py)
+if python - <<'EOF'
+import json, sys
+try:
+    d = json.load(open("artifacts/flagship/aot_v5e_b64_pairhess.json"))
+    sys.exit(0 if d.get("hbm_fits_v5e") else 1)
+except Exception:
+    sys.exit(1)
+EOF
+then
+    run 7200 bench_pairhess env BENCH_PAIRED_HESSIAN=1 BENCH_NO_FALLBACK=1 \
+        BENCH_RETRIES=2 python bench.py
+else
+    echo "window5b: no pairhess fit-proof — skipping" | tee -a "$LOG/driver.log"
+fi
+
+echo "=== window5b complete $(date -u +%F' '%T)" | tee -a "$LOG/driver.log"
