@@ -78,6 +78,7 @@ def run_darts_search(
     fused: bool = False,
     scan_unroll: int | None = None,
     augment_fn=None,
+    search_augment: bool | None = None,
 ) -> dict[str, Any]:
     """Run the bilevel architecture search; returns genotype + final metrics.
 
@@ -211,7 +212,9 @@ def run_darts_search(
     # reproducible from the seed and survives resume.  Default-off: it
     # changes the compiled epoch program, so the flagship's terminal-cache
     # and resume compatibility within a round are preserved.
-    if augment_fn is None and parse_bool(os.environ.get("KATIB_SEARCH_AUG")):
+    if search_augment is None:
+        search_augment = parse_bool(os.environ.get("KATIB_SEARCH_AUG"))
+    if augment_fn is None and search_augment:
         from katib_tpu.models.augmentation import random_crop_flip
 
         augment_fn = random_crop_flip
@@ -483,7 +486,15 @@ def darts_trial(ctx) -> None:
         if name == "total_steps" or name not in settings:
             continue
         raw = settings[name]
-        overrides[name] = parse_bool(raw, default=True) if name == "unrolled" else float(raw)
+        # bool fields (unrolled / paired_hessian / debug_alpha_grad) parse
+        # as booleans, keyed off the field default's type so a new flag
+        # cannot silently float()-coerce; a null/absent-ish value falls
+        # back to the FIELD's default, not a blanket True
+        default = DartsHyper._field_defaults.get(name)
+        if isinstance(default, bool):
+            overrides[name] = parse_bool(raw, default=default)
+        else:
+            overrides[name] = float(raw)
     hyper = DartsHyper(**overrides)
 
     stopped = [False]
@@ -513,6 +524,15 @@ def darts_trial(ctx) -> None:
         # algorithm setting "fused": the fused mixed-op evaluation plan
         # (nas/darts/fused.py) — a Katib-style CR can request it
         fused=parse_bool(settings.get("fused")),
+        # algorithm setting "search_augment": the reference's crop+flip
+        # search transforms (run_trial.py:98-111); the fn selection lives
+        # in run_darts_search so the env path and this one cannot diverge
+        # (absent setting -> None -> the env fallback still applies)
+        search_augment=(
+            parse_bool(settings["search_augment"])
+            if "search_augment" in settings
+            else None
+        ),
         # per-epoch snapshots under the trial's checkpoint dir: a preempted
         # trial re-runs from its last completed epoch, not from scratch
         checkpoint_dir=(

@@ -175,6 +175,64 @@ class TestDartsModel:
         # the search snapshot landed under the trial dir (preemption resume)
         assert (tmp_path / "trial0" / "search").is_dir()
 
+    def test_darts_trial_honors_search_augment_and_paired_settings(self, tmp_path):
+        """Katib-style algorithm settings flow through to the search: the
+        reference's crop+flip search transforms (search_augment) and the
+        paired finite-difference Hessian (paired_hessian, a bool field
+        that must parse as a bool, not float-coerce)."""
+        import json as _json
+
+        from katib_tpu.nas.darts.search import darts_trial
+        from katib_tpu.runner.context import TrialContext
+
+        reports: list[dict] = []
+
+        class Ctx:
+            params = {
+                "algorithm-settings": _json.dumps({
+                    "dataset": "digits", "n_train": "96", "n_test": "48",
+                    "num_epochs": "1", "batch_size": "16",
+                    "init_channels": "4", "num_nodes": "2",
+                    "search_augment": "true", "paired_hessian": "true",
+                }),
+                "search-space": _json.dumps(list(TINY_PRIMS)),
+                "num-layers": "2",
+            }
+            checkpoint_dir = str(tmp_path / "trial1")
+            mesh = None
+            _checkpointer = None
+
+            def report(self, **kw):
+                reports.append(kw)
+                return True
+
+            ensure_checkpoint_dir = TrialContext.ensure_checkpoint_dir
+            checkpointer = TrialContext.checkpointer
+            save_checkpoint = TrialContext.save_checkpoint
+            restore_checkpoint = TrialContext.restore_checkpoint
+
+        # record that the augmentation actually runs inside the search
+        # (imported at call time, so patching the module attr intercepts)
+        import katib_tpu.models.augmentation as aug_mod
+
+        calls = []
+        real = aug_mod.random_crop_flip
+
+        def recording(key, x, **kw):
+            calls.append(x.shape)
+            return real(key, x, **kw)
+
+        orig = aug_mod.random_crop_flip
+        aug_mod.random_crop_flip = recording
+        try:
+            darts_trial(Ctx())
+        finally:
+            aug_mod.random_crop_flip = orig
+        geno = _json.loads((tmp_path / "trial1" / "genotype.json").read_text())
+        assert geno["normal"] and geno["reduce"]
+        assert reports and all(0.0 <= r["accuracy"] <= 1.0 for r in reports)
+        assert calls, "search_augment setting did not reach the epoch body"
+
     def test_search_resumes_from_checkpoint(self, tmp_path):
         """A restarted search picks up at the last completed epoch (flaky
         single-chip pools: a relay drop must not restart a long search)."""
