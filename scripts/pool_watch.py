@@ -38,6 +38,7 @@ def probe(timeout: float) -> bool:
             timeout=timeout,
         )
     except subprocess.TimeoutExpired:
+        print(f"probe timed out at {timeout:.0f}s (device init blocked)", flush=True)
         return False
     ok = proc.returncode == 0 and "POOL_OK" in (proc.stdout or "")
     if ok:
