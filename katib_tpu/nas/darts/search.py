@@ -40,6 +40,17 @@ from katib_tpu.utils.booleans import parse_bool
 _SEARCH_META = "search_meta.json"
 
 
+def _draw_epoch_indices(seed: int, epoch: int, n_w: int, n_a: int, n_used: int):
+    """Per-epoch batch permutations, one stream per (seed, epoch): w's draw
+    first, then a's.  Shared by the scan and device-resident step-loop
+    paths; the host-streamed path draws the same order lazily inside
+    ``batches()`` (equality is pinned by the parity tests, not by sharing
+    this function) — batch composition equality across paths is
+    load-bearing for resume and for reproducibility."""
+    erng = np.random.default_rng([seed, epoch])
+    return erng.permutation(n_w)[:n_used], erng.permutation(n_a)[:n_used]
+
+
 def _read_search_meta(checkpoint_dir: str) -> dict | None:
     try:
         with open(os.path.join(checkpoint_dir, _SEARCH_META)) as f:
@@ -228,6 +239,17 @@ def run_darts_search(
     scan_steps = len(x_w) // batch_size
     device_data = device_data and mesh is None and scan_steps >= 1
     scan_epoch = None
+    # KATIB_STEP_LOOP=1: keep the splits device-resident but drive the
+    # SINGLE-STEP program from the host (one async dispatch per step plus a
+    # tiny on-device gather) instead of jitting the whole-epoch scan.  The
+    # epoch scan is the throughput default, but its program is ~epoch-sized
+    # and a terminal-side compile of it can dwarf the single step's (~8 min
+    # measured); when the pool's compile path is the bottleneck this mode
+    # trades ~1.5 ms/step dispatch overhead for compiling only the step.
+    # Dispatches stay async (losses fetched once per epoch), batch
+    # composition and augmentation keying are identical to the scan path.
+    step_loop = parse_bool(os.environ.get("KATIB_STEP_LOOP"))
+    gather_batches = None
     if device_data:
         # splits live in HBM for the whole search; the epoch is one jitted
         # scan over [steps, batch] permutation indices with on-device gather
@@ -244,23 +266,34 @@ def run_darts_search(
         if scan_unroll is None:
             scan_unroll = int(os.environ.get("KATIB_SCAN_UNROLL", "1"))
 
-        def _epoch(state, xw, yw, xa, ya, w_ix, a_ix):
-            def body(s, ix):
-                wi, ai = ix
-                xb = xw[wi]
-                if augment_fn is not None:
-                    xb = augment_fn(jax.random.fold_in(aug_key, s.step), xb)
-                s, m = search_step(s, (xb, yw[wi]), (xa[ai], ya[ai]))
-                return s, m["train_loss"]
-
-            return jax.lax.scan(
-                body, state, (w_ix, a_ix), unroll=max(1, scan_unroll)
+        if step_loop:
+            # per-step on-device gather; the step itself is the separately
+            # jitted search_step program
+            gather_batches = jax.jit(
+                lambda xw, yw, xa, ya, wi, ai: (
+                    (xw[wi], yw[wi]),
+                    (xa[ai], ya[ai]),
+                )
             )
+        else:
 
-        # donate the carried state: the bilevel step holds two full weight
-        # copies already — double-buffering a third across the epoch call
-        # would waste HBM
-        scan_epoch = jax.jit(_epoch, donate_argnums=(0,))
+            def _epoch(state, xw, yw, xa, ya, w_ix, a_ix):
+                def body(s, ix):
+                    wi, ai = ix
+                    xb = xw[wi]
+                    if augment_fn is not None:
+                        xb = augment_fn(jax.random.fold_in(aug_key, s.step), xb)
+                    s, m = search_step(s, (xb, yw[wi]), (xa[ai], ya[ai]))
+                    return s, m["train_loss"]
+
+                return jax.lax.scan(
+                    body, state, (w_ix, a_ix), unroll=max(1, scan_unroll)
+                )
+
+            # donate the carried state: the bilevel step holds two full
+            # weight copies already — double-buffering a third across the
+            # epoch call would waste HBM
+            scan_epoch = jax.jit(_epoch, donate_argnums=(0,))
 
     # optional native prefetch: C++ worker threads gather the next shuffled
     # batch while the device runs the current bilevel step (enable with
@@ -343,13 +376,10 @@ def run_darts_search(
         for epoch in range(start_epoch, num_epochs):
             t_mark = time.perf_counter()
             if scan_epoch is not None:
-                # identical draw order to the batches() path below: w's
-                # permutation first, then a's, from the same (seed, epoch)
-                # stream
-                erng = np.random.default_rng([seed, epoch])
                 n_used = scan_steps * batch_size
-                w_ix = erng.permutation(len(x_w))[:n_used]
-                a_ix = erng.permutation(len(x_a))[:n_used]
+                w_ix, a_ix = _draw_epoch_indices(
+                    seed, epoch, len(x_w), len(x_a), n_used
+                )
                 shape = (scan_steps, batch_size)
                 state, losses = scan_epoch(
                     state,
@@ -365,24 +395,51 @@ def run_darts_search(
                 train_loss = float(jnp.sum(losses))
                 t_mark = _trace("loss-fetch", t_mark)
             else:
-                if native_loaders is not None:
-                    w_stream = native_loaders[0].epoch()
-                    a_stream = native_loaders[1].epoch()
+                # one shared per-step loop body for every host-driven epoch
+                # path; only the batch source differs (review: the augment
+                # keying and async loss handling must not live in two
+                # hand-synced copies)
+                if gather_batches is not None:
+                    # device-resident step loop: batches gathered on-device
+                    # from the scan path's exact permutation draws
+                    n_used = scan_steps * batch_size
+                    w_ix, a_ix = _draw_epoch_indices(
+                        seed, epoch, len(x_w), len(x_a), n_used
+                    )
+                    w_ix = w_ix.reshape(scan_steps, batch_size)
+                    a_ix = a_ix.reshape(scan_steps, batch_size)
+                    pair_stream = (
+                        gather_batches(
+                            xw_d,
+                            yw_d,
+                            xa_d,
+                            ya_d,
+                            jnp.asarray(w_ix[i], jnp.int32),
+                            jnp.asarray(a_ix[i], jnp.int32),
+                        )
+                        for i in range(scan_steps)
+                    )
+                elif native_loaders is not None:
+                    pair_stream = zip(
+                        native_loaders[0].epoch(), native_loaders[1].epoch()
+                    )
                 else:
                     # per-epoch stream keyed on (seed, epoch): a run resumed
                     # at epoch k shuffles exactly like the uninterrupted run
                     # would have — a shared sequential rng would replay
                     # epoch 0's order after every restart
                     erng = np.random.default_rng([seed, epoch])
-                    w_stream = batches(x_w, y_w, batch_size, erng)
-                    a_stream = batches(x_a, y_a, batch_size, erng)
+                    pair_stream = zip(
+                        batches(x_w, y_w, batch_size, erng),
+                        batches(x_a, y_a, batch_size, erng),
+                    )
                 # keep per-step losses as device futures: float()-ing inside
                 # the loop would block the host on every step and serialize
                 # the async dispatch pipeline (one device round-trip per
                 # step — on a tunneled chip that is the dominant cost); one
                 # transfer per epoch instead
                 step_losses = []
-                for wb, ab in zip(w_stream, a_stream):
+                for wb, ab in pair_stream:
                     if mesh is not None:
                         wb, ab = shard_batch(wb, mesh), shard_batch(ab, mesh)
                     if aug_step is not None:
@@ -397,9 +454,11 @@ def run_darts_search(
                     state, metrics = search_step(state, wb, ab)
                     step_losses.append(metrics["train_loss"])
                 steps = len(step_losses)
+                t_mark = _trace("step-dispatch", t_mark)
                 train_loss = (
                     float(np.sum(jax.device_get(step_losses))) if steps else 0.0
                 )
+                t_mark = _trace("loss-fetch", t_mark)
 
             em = evaluate((state.weights, state.alphas), eval_batch)
             val_acc = float(em["accuracy"])

@@ -14,7 +14,9 @@ script will submit it to the chip.  Missing AOT memo => the config is
 SKIPPED with a note, never attempted.
 
 Artifacts: ``artifacts/flagship/batch_scaling.json``.
-Env knobs: SCALING_CONFIGS (comma list like ``64:none,128:dots``),
+Env knobs: SCALING_CONFIGS (comma list like ``64:none,128:dots``; a third
+``:ph`` field adds the paired-Hessian step variant, e.g. ``128:dots:ph`` —
+its fit-proof is looked up under the matching ``_pairhess`` tag),
 BENCH_STEPS per point (default 5).
 """
 
@@ -36,22 +38,36 @@ from _common import (  # noqa: E402
 RESULT_PREFIX = '{"metric"'
 
 
-def parse_configs(raw: str) -> list[tuple[int, str | None]]:
-    out: list[tuple[int, str | None]] = []
+def parse_configs(raw: str) -> list[tuple[int, str | None, bool]]:
+    out: list[tuple[int, str | None, bool]] = []
     for part in raw.split(","):
-        batch, _, policy = part.strip().partition(":")
-        out.append((int(batch), None if policy in ("", "none") else policy))
+        fields = [f.strip() for f in part.strip().split(":")]
+        # fail fast on anything unrecognized: a typo'd config that silently
+        # parsed as the non-variant would burn a fit-proof-gated chip point
+        # on the wrong program and only surface after the window ends
+        if len(fields) > 3:
+            raise ValueError(f"SCALING_CONFIGS entry has >3 fields: {part!r}")
+        if len(fields) > 2 and fields[2] != "ph":
+            raise ValueError(
+                f"unknown variant field {fields[2]!r} in {part!r} (only 'ph')"
+            )
+        batch = int(fields[0])
+        policy = fields[1] if len(fields) > 1 and fields[1] not in ("", "none") else None
+        pairhess = len(fields) > 2
+        out.append((batch, policy, pairhess))
     return out
 
 
-def aot_block_for(batch: int, policy: str | None) -> dict | None:
+def aot_block_for(batch: int, policy: str | None, pairhess: bool = False) -> dict | None:
     """The committed deviceless-AOT evidence for this config, or None."""
-    if policy is None and batch == 64:
+    if policy is None and batch == 64 and not pairhess:
         name = "aot_v5e.json"
     else:
         tag = f"b{batch}" + ("_remat" if policy is not None else "")
         if policy:
             tag += f"_{policy}"
+        if pairhess:
+            tag += "_pairhess"
         name = f"aot_v5e_{tag}.json"
     try:
         with open(os.path.join(artifacts_root(), "flagship", name)) as f:
@@ -69,13 +85,14 @@ def main() -> int:
     # before the bench child even starts
     remote_compile = _local_compile_probe() is False
     points: list[dict] = []
-    for batch, policy in configs:
-        aot = aot_block_for(batch, policy)
+    for batch, policy, pairhess in configs:
+        aot = aot_block_for(batch, policy, pairhess)
         if aot is None or not aot.get("hbm_fits_v5e"):
             points.append(
                 {
                     "batch": batch,
                     "remat_policy": policy,
+                    "paired_hessian": pairhess,
                     "skipped": True,
                     "reason": (
                         "no committed AOT fit-proof — oversized terminal "
@@ -109,7 +126,11 @@ def main() -> int:
         else:
             env.pop("BENCH_REMAT", None)
             env.pop("BENCH_REMAT_POLICY", None)
-        print(f"scaling: batch={batch} policy={policy} ...", flush=True)
+        if pairhess:
+            env["BENCH_PAIRED_HESSIAN"] = "1"
+        else:
+            env.pop("BENCH_PAIRED_HESSIAN", None)
+        print(f"scaling: batch={batch} policy={policy} pairhess={pairhess} ...", flush=True)
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.join(REPO, "bench.py")],
@@ -124,6 +145,7 @@ def main() -> int:
                 {
                     "batch": batch,
                     "remat_policy": policy,
+                    "paired_hessian": pairhess,
                     "failed": True,
                     "timeout": True,
                 }
@@ -138,6 +160,7 @@ def main() -> int:
                 {
                     "batch": batch,
                     "remat_policy": policy,
+                    "paired_hessian": pairhess,
                     "failed": True,
                     "stderr_tail": (proc.stderr or "")[-500:],
                 }
@@ -147,6 +170,7 @@ def main() -> int:
             {
                 "batch": batch,
                 "remat_policy": policy,
+                "paired_hessian": pairhess,
                 "images_per_sec": rec["value"],
                 "step_secs": rec["step_secs"],
                 "mfu": rec["mfu"],

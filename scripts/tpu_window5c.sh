@@ -1,0 +1,139 @@
+#!/usr/bin/env bash
+# Takeover queue for round 5, replacing tpu_window5.sh from step 2 on.
+# Context: window5's step-2 flagship (epoch-scan program) sat >2h in a
+# terminal-side compile that never returned, while the single-step program
+# compiled in ~8 min in the same window — so the flagship here runs in
+# KATIB_STEP_LOOP=1 mode (device-resident splits, per-step dispatch of the
+# single-step program; search.py), whose compile cost is known-bounded.
+# Also folds window5b's paired-Hessian A/B into the batch-scaling step via
+# the new `batch:policy:ph` config syntax.
+# Usage: setsid bash scripts/tpu_window5c.sh &   Logs: /tmp/tpu_window5c/
+set -u
+cd "$(dirname "$0")/.."
+LOG=/tmp/tpu_window5c
+ART=/tmp/tpu_window5c/artifacts
+mkdir -p "$LOG"
+
+probe() {
+    env POOL_WATCH_PROBE_TIMEOUT=180 POOL_WATCH_INTERVAL=120 \
+        POOL_WATCH_MAX_HOURS=8 python scripts/pool_watch.py \
+        >>"$LOG/pool_watch.log" 2>&1
+}
+
+run() {
+    local t=$1 name=$2; shift 2
+    echo "=== $name start $(date -u +%F' '%T)" | tee -a "$LOG/driver.log"
+    setsid "$@" >"$LOG/$name.log" 2>&1 &
+    local pid=$!
+    ( sleep "$t" && kill -- -"$pid" 2>/dev/null && sleep 20 \
+        && kill -9 -- -"$pid" 2>/dev/null ) &
+    local watcher=$!
+    local rc=0
+    wait "$pid" || rc=$?
+    kill "$watcher" 2>/dev/null; wait "$watcher" 2>/dev/null
+    kill -9 -- -"$pid" 2>/dev/null
+    echo "=== $name rc=$rc end $(date -u +%F' '%T)" | tee -a "$LOG/driver.log"
+    return $rc
+}
+
+probe || exit 1
+
+# 1. flagship at 50 epochs in step-loop mode.  Per-epoch Orbax snapshots +
+#    watchdog exit-75 keep mid-run stalls resume-safe; loop attempts.
+for attempt in 1 2 3; do
+    run 9000 flagship_steploop_$attempt env KATIB_STEP_LOOP=1 \
+        FLAGSHIP_EPOCHS=50 FLAGSHIP_BATCH=64 FLAGSHIP_REMAT=0 \
+        FLAGSHIP_FUSED=0 python scripts/run_flagship_tpu.py
+    rc=$?
+    [ "$rc" -eq 0 ] && break
+    echo "=== flagship attempt $attempt rc=$rc — reprobing" >>"$LOG/driver.log"
+    probe || exit 1
+done
+
+probe || exit 1
+
+# 2. augment the discovered genotype: accuracy-vs-epoch + honest timing
+run 5400 augment_genotype env AUGMENT_EPOCHS=20 python scripts/run_augment_tpu.py
+
+probe || exit 1
+
+# 3. batch scaling incl. the paired-Hessian combos (every config carries a
+#    committed fit-proof; b96:dots:ph auto-skips until its proof lands)
+run 12000 batch_scaling env \
+    SCALING_CONFIGS="64:none,96:dots,128:dots,64:none:ph,128:dots:ph,96:dots:ph" \
+    python scripts/run_batch_scaling.py
+
+probe || exit 1
+
+# 4. Hyperband sweep serialized on the chip (redirected, copied back)
+run 5400 hyperband_tpu env SWEEP_PLATFORM=axon KATIB_ARTIFACTS_DIR="$ART" \
+    python scripts/run_hyperband_sweep.py
+[ -f "$ART/hyperband/sweep_summary.json" ] && \
+    cp "$ART/hyperband/sweep_summary.json" artifacts/hyperband/sweep_summary_tpu.json
+
+probe || exit 1
+
+# 5. op microbench: two-point dispatch/marginal fit + unroll atoms
+run 3600 op_microbench python scripts/run_op_microbench.py
+
+probe || exit 1
+
+# 6. full-step scan-unroll A/B (two fresh terminal compiles; keep last)
+run 7200 scan_unroll_ab env UNROLL_FACTORS=1,2 BENCH_RETRIES=2 \
+    python scripts/run_scan_unroll_ab.py
+
+probe || exit 1
+
+# 7. paper-protocol augment: one step timed at 20 cells, 600-epoch
+#    accounting — redirected + copied back
+run 5400 augment_20cell env AUGMENT_LAYERS=20 AUGMENT_CHANNELS=36 \
+    AUGMENT_EPOCHS=1 AUGMENT_ACCOUNT_EPOCHS=600 \
+    KATIB_ARTIFACTS_DIR="$ART" python scripts/run_augment_tpu.py
+for f in augment_tpu augment_aot; do
+    [ -f "$ART/flagship/$f.json" ] && \
+        cp "$ART/flagship/$f.json" "artifacts/flagship/${f}_20cell.json"
+done
+
+# 7b. the 20-cell step at batch 384 (fit-proof-gated; augment is the paper
+#     protocol's long pole and overhead-bound at b96)
+if [ -f artifacts/flagship/augment_aot_20cell_b384.json ]; then
+    probe || exit 1
+    cp artifacts/flagship/augment_aot_20cell_b384.json "$ART/flagship/augment_aot.json"
+    rm -f "$ART/flagship/augment_tpu.json"
+    run 5400 augment_20cell_b384 env AUGMENT_LAYERS=20 AUGMENT_CHANNELS=36 \
+        AUGMENT_BATCH=384 AUGMENT_EPOCHS=1 AUGMENT_ACCOUNT_EPOCHS=600 \
+        KATIB_ARTIFACTS_DIR="$ART" python scripts/run_augment_tpu.py
+    [ -f "$ART/flagship/augment_tpu.json" ] && \
+        cp "$ART/flagship/augment_tpu.json" artifacts/flagship/augment_tpu_20cell_b384.json
+fi
+
+probe || exit 1
+
+# 8. real-data on-chip runs carried from window4
+run 3600 nas_digits env DEMO_PLATFORM=axon KATIB_ARTIFACTS_DIR="$ART" \
+    python scripts/run_nas_real_data.py
+[ -f "$ART/real_data/digits_nas.json" ] && \
+    cp "$ART/real_data/digits_nas.json" artifacts/real_data/digits_nas_tpu.json
+
+probe || exit 1
+
+run 3600 enas_digits env ENAS_PLATFORM=axon ENAS_DATASET=digits \
+    KATIB_ARTIFACTS_DIR="$ART" python scripts/run_enas_demo.py
+[ -f "$ART/enas/digits_summary.json" ] && \
+    cp "$ART/enas/digits_summary.json" artifacts/enas/digits_summary_tpu.json
+
+probe || exit 1
+
+run 3600 pbt_digits env PBT_PLATFORM=axon PBT_DATASET=digits \
+    PBT_GENERATIONS=6 KATIB_ARTIFACTS_DIR="$ART" \
+    python scripts/run_pbt_demo.py
+[ -f "$ART/pbt/digits_summary.json" ] && \
+    cp "$ART/pbt/digits_summary.json" artifacts/pbt/digits_summary_tpu.json
+
+probe || exit 1
+
+# 9. closing live bench: fresh on-chip memo + warm terminal cache so the
+#    driver's end-of-round run completes live
+run 5400 bench_final env BENCH_RETRIES=2 python bench.py
+
+echo "=== window5c complete $(date -u +%F' '%T)" | tee -a "$LOG/driver.log"

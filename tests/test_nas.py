@@ -574,6 +574,37 @@ class TestDeviceDataSearch:
         assert streamed["genotype"].normal == scanned["genotype"].normal
         assert streamed["genotype"].reduce == scanned["genotype"].reduce
 
+    def test_step_loop_matches_scan_path(self, monkeypatch):
+        """KATIB_STEP_LOOP=1 (device-resident splits, per-step dispatch of
+        the single-step program with an on-device gather) must reproduce
+        the scan path's trajectory: the mode exists so a pool whose
+        terminal-side compile of the epoch-sized scan program stalls can
+        still run the flagship off the cheap single-step compile — it must
+        change the dispatch granularity, not the math."""
+        from katib_tpu.models.data import synthetic_classification
+        from katib_tpu.nas.darts.architect import DartsHyper
+        from katib_tpu.nas.darts.search import run_darts_search
+
+        ds = synthetic_classification(96, 48, (12, 12, 3), 6, seed=0)
+        kw = dict(
+            num_layers=2, init_channels=4, n_nodes=2, num_epochs=2,
+            batch_size=16, hyper=DartsHyper(unrolled=True), seed=3,
+            # augmentation ON so the step-loop's per-step aug_step +
+            # fold_in(aug_key, state.step) keying is compared against the
+            # scan body's in-jit fold — the claim that the mode changes
+            # dispatch granularity, not math, includes the augment branch
+            search_augment=True,
+        )
+        monkeypatch.delenv("KATIB_STEP_LOOP", raising=False)
+        scanned = run_darts_search(ds, device_data=True, **kw)
+        monkeypatch.setenv("KATIB_STEP_LOOP", "1")
+        stepped = run_darts_search(ds, device_data=True, **kw)
+        for a, b in zip(scanned["history"], stepped["history"]):
+            assert a["val_accuracy"] == pytest.approx(b["val_accuracy"], abs=1e-5)
+            assert a["train_loss"] == pytest.approx(b["train_loss"], rel=1e-4)
+        assert scanned["genotype"].normal == stepped["genotype"].normal
+        assert scanned["genotype"].reduce == stepped["genotype"].reduce
+
     def test_split_smaller_than_batch_falls_back(self):
         """A split smaller than one batch has zero full batches; the scan
         path must stand down (not crash on a short permutation reshape)."""
