@@ -334,6 +334,63 @@ def cmd_conformance(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_trace_export(args: argparse.Namespace) -> int:
+    import json as _json
+
+    from katib_tpu.utils import tracing
+
+    journal = tracing.trace_path(args.workdir, args.experiment)
+    if not os.path.exists(journal):
+        print(f"no trace journal at {journal}", file=sys.stderr)
+        return 1
+    if args.out == "-":
+        records = tracing.read_journal(journal)
+        if not records:
+            print(f"trace journal {journal} holds no valid spans", file=sys.stderr)
+            return 1
+        _json.dump(tracing.to_chrome_trace(records), sys.stdout)
+        print()
+        return 0
+    out = args.out or os.path.join(args.workdir, args.experiment, "trace.json")
+    n = tracing.export_chrome_trace(journal, out)
+    if n == 0:
+        print(f"trace journal {journal} holds no valid spans", file=sys.stderr)
+        return 1
+    print(f"wrote {n} spans to {out} (open in Perfetto / chrome://tracing)")
+    return 0
+
+
+def cmd_trace_summary(args: argparse.Namespace) -> int:
+    import json as _json
+
+    from katib_tpu.utils import tracing
+
+    journal = tracing.trace_path(args.workdir, args.experiment)
+    records = tracing.read_journal(journal)
+    if not records:
+        print(f"no spans found at {journal}", file=sys.stderr)
+        return 1
+    summary = tracing.summarize(records)
+    if args.json:
+        _json.dump(summary, sys.stdout, indent=2)
+        print()
+        return 0
+    rows = [
+        [
+            s["name"],
+            s["count"],
+            f"{s['total_s']:.3f}",
+            f"{s['mean_s']:.4f}",
+            f"{s['p50_s']:.4f}",
+            f"{s['p95_s']:.4f}",
+            f"{s['max_s']:.4f}",
+        ]
+        for s in summary
+    ]
+    print(_table(rows, ["SPAN", "COUNT", "TOTAL_S", "MEAN_S", "P50_S", "P95_S", "MAX_S"]))
+    return 0
+
+
 def cmd_db_manager(args: argparse.Namespace) -> int:
     """Run the native db-manager daemon standalone (the reference ships it
     as its own binary, ``cmd/db-manager/v1beta1/main.go:51``).  ``--db``
@@ -571,6 +628,27 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("trial")
     p.add_argument("--workdir", default="katib_runs")
     p.set_defaults(fn=cmd_logs)
+
+    p = sub.add_parser("trace", help="export/summarize an experiment's span journal")
+    trace_sub = p.add_subparsers(dest="trace_cmd", required=True)
+    tp = trace_sub.add_parser(
+        "export", help="trace journal -> Chrome-trace JSON (Perfetto-loadable)"
+    )
+    tp.add_argument("experiment")
+    tp.add_argument("--workdir", default="katib_runs")
+    tp.add_argument(
+        "--out",
+        default=None,
+        help="output path (default <workdir>/<experiment>/trace.json; '-' for stdout)",
+    )
+    tp.set_defaults(fn=cmd_trace_export)
+    tp = trace_sub.add_parser(
+        "summary", help="per-span latency distribution (count/total/p50/p95)"
+    )
+    tp.add_argument("experiment")
+    tp.add_argument("--workdir", default="katib_runs")
+    tp.add_argument("--json", action="store_true")
+    tp.set_defaults(fn=cmd_trace_summary)
 
     p = sub.add_parser("conformance", help="packaged e2e invariants check")
     p.add_argument("--max-trials", type=int, default=8)

@@ -35,9 +35,25 @@ from katib_tpu.nas.darts.model import (
 from katib_tpu.nas.darts.ops import DEFAULT_PRIMITIVES
 from katib_tpu.parallel.mesh import needs_safe_conv, replicate, shard_batch
 from katib_tpu.parallel.train import accuracy, cross_entropy_loss, make_eval_step
+from katib_tpu.utils import observability as obs
+from katib_tpu.utils import tracing
 from katib_tpu.utils.booleans import parse_bool
 
 _SEARCH_META = "search_meta.json"
+
+
+def _record_first_step(compile_s: float, execute_s: float, workload: str) -> None:
+    """First-step latency split: under async dispatch the first jitted call
+    blocks on trace+compile, fetching its result blocks on execution."""
+    obs.trial_first_step_seconds.set(compile_s, phase="compile", workload=workload)
+    obs.trial_first_step_seconds.set(execute_s, phase="execute", workload=workload)
+    tracing.record_span(
+        "first_step",
+        compile_s + execute_s,
+        workload=workload,
+        compile_s=round(compile_s, 4),
+        execute_s=round(execute_s, 4),
+    )
 
 
 def _draw_epoch_indices(seed: int, epoch: int, n_w: int, n_a: int, n_used: int):
@@ -375,12 +391,14 @@ def run_darts_search(
     try:
         for epoch in range(start_epoch, num_epochs):
             t_mark = time.perf_counter()
+            t_epoch = t_mark
             if scan_epoch is not None:
                 n_used = scan_steps * batch_size
                 w_ix, a_ix = _draw_epoch_indices(
                     seed, epoch, len(x_w), len(x_a), n_used
                 )
                 shape = (scan_steps, batch_size)
+                t_dispatch = time.perf_counter()
                 state, losses = scan_epoch(
                     state,
                     xw_d,
@@ -390,10 +408,17 @@ def run_darts_search(
                     jnp.asarray(w_ix.reshape(shape), jnp.int32),
                     jnp.asarray(a_ix.reshape(shape), jnp.int32),
                 )
+                dispatch_s = time.perf_counter() - t_dispatch
                 steps = scan_steps
                 t_mark = _trace("scan-dispatch", t_mark)
+                t_fetch = time.perf_counter()
                 train_loss = float(jnp.sum(losses))
+                fetch_s = time.perf_counter() - t_fetch
                 t_mark = _trace("loss-fetch", t_mark)
+                if epoch == start_epoch:
+                    # whole-epoch scan: dispatch blocks on trace+compile,
+                    # the loss fetch blocks on the epoch's execution
+                    _record_first_step(dispatch_s, fetch_s, "darts-scan")
             else:
                 # one shared per-step loop body for every host-driven epoch
                 # path; only the batch source differs (review: the augment
@@ -439,6 +464,9 @@ def run_darts_search(
                 # step — on a tunneled chip that is the dominant cost); one
                 # transfer per epoch instead
                 step_losses = []
+                # first-step split (start epoch only): one extra host sync
+                # on step 0, the remaining steps keep the async pipeline
+                first_pending = epoch == start_epoch
                 for wb, ab in pair_stream:
                     if mesh is not None:
                         wb, ab = shard_batch(wb, mesh), shard_batch(ab, mesh)
@@ -451,7 +479,18 @@ def run_darts_search(
                             ),
                             wb[1],
                         )
-                    state, metrics = search_step(state, wb, ab)
+                    if first_pending:
+                        first_pending = False
+                        t_first = time.perf_counter()
+                        state, metrics = search_step(state, wb, ab)
+                        compile_s = time.perf_counter() - t_first
+                        t_first = time.perf_counter()
+                        jax.block_until_ready(metrics["train_loss"])
+                        _record_first_step(
+                            compile_s, time.perf_counter() - t_first, "darts"
+                        )
+                    else:
+                        state, metrics = search_step(state, wb, ab)
                     step_losses.append(metrics["train_loss"])
                 steps = len(step_losses)
                 t_mark = _trace("step-dispatch", t_mark)
@@ -464,6 +503,21 @@ def run_darts_search(
             val_acc = float(em["accuracy"])
             t_mark = _trace("eval", t_mark)
             best_acc = max(best_acc, val_acc)
+            # per-epoch telemetry: step-time distribution, throughput gauge,
+            # HBM gauges, and one "darts.epoch" span in the trace journal
+            epoch_s = time.perf_counter() - t_epoch
+            obs.trial_step_seconds.observe(epoch_s / max(steps, 1), workload="darts")
+            images_per_s = (steps * batch_size) / epoch_s if epoch_s > 0 else 0.0
+            obs.trial_images_per_second.set(images_per_s, workload="darts")
+            obs.record_device_memory()
+            tracing.record_span(
+                "darts.epoch",
+                epoch_s,
+                epoch=epoch,
+                steps=steps,
+                images_per_s=round(images_per_s, 1),
+                val_accuracy=round(val_acc, 4),
+            )
             history.append(
                 {
                     "epoch": epoch,

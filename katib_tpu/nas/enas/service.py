@@ -192,11 +192,20 @@ class EnasSuggester(Suggester):
             if (self.round - 1) not in self._trained_rounds:
                 reward = self._mean_reward(prev)
                 if reward is not None:
-                    for _ in range(self.train_steps):
-                        arc, _ = self._sample(self.state.params, self._next_key())
-                        self.state, _ = self._train_step(
-                            self.state, arc, np.float32(reward)
-                        )
+                    from katib_tpu.utils import tracing
+
+                    with tracing.span(
+                        "enas.controller_train",
+                        round=self.round - 1,
+                        steps=self.train_steps,
+                    ):
+                        for _ in range(self.train_steps):
+                            arc, _ = self._sample(
+                                self.state.params, self._next_key()
+                            )
+                            self.state, _ = self._train_step(
+                                self.state, arc, np.float32(reward)
+                            )
                 self._trained_rounds.add(self.round - 1)
 
         nn_config = json.dumps(

@@ -10,6 +10,7 @@ context instead of stdout lines, on a JAX mesh instead of MirroredStrategy.
 from __future__ import annotations
 
 import json
+import time
 
 from katib_tpu.models.data import load_named_dataset
 from katib_tpu.models.mnist import train_classifier
@@ -44,7 +45,31 @@ def enas_trial(ctx) -> None:
         int(n_test) if n_test is not None else None,
     )
 
+    # per-epoch telemetry rides the report callback: the interval between
+    # calls is one training epoch (train_classifier reports once per epoch)
+    from katib_tpu.utils import observability as obs
+    from katib_tpu.utils import tracing
+
+    epochs = int(ctx.params.get("num_epochs", 3))
+    batch_size = int(ctx.params.get("batch_size", 128))
+    last_report = [time.perf_counter()]
+
     def report(epoch, accuracy, loss):
+        now = time.perf_counter()
+        epoch_s, last_report[0] = now - last_report[0], now
+        steps = max(len(dataset.x_train) // batch_size, 1)
+        obs.trial_step_seconds.observe(epoch_s / steps, workload="enas")
+        images_per_s = (steps * batch_size) / epoch_s if epoch_s > 0 else 0.0
+        obs.trial_images_per_second.set(images_per_s, workload="enas")
+        obs.record_device_memory()
+        tracing.record_span(
+            "enas.epoch",
+            epoch_s,
+            trial=ctx.trial_name,
+            epoch=epoch,
+            images_per_s=round(images_per_s, 1),
+            accuracy=round(float(accuracy), 4),
+        )
         return ctx.report(step=epoch, accuracy=accuracy, loss=loss)
 
     # opt-in ENAS weight sharing (the paper's core efficiency idea, which

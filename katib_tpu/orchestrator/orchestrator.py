@@ -51,6 +51,7 @@ from katib_tpu.suggest.base import (
     make_suggester,
 )
 from katib_tpu.utils import observability as obs
+from katib_tpu.utils import tracing
 
 
 class Orchestrator:
@@ -90,6 +91,11 @@ class Orchestrator:
         # trials whose checkpoint dir belongs to the suggester (PBT lineage)
         # — exempt from retain-cleanup
         self._suggester_owned_ckpts: set[str] = set()
+        # per-experiment span tracer (utils.tracing); opened in run(), closed
+        # by _finish(); trial pool threads pick it up via self._tracer
+        self._tracer: tracing.Tracer | None = None
+        self._prev_tracer: tracing.Tracer | None = None
+        self._exp_span_start = 0.0
 
     def stop(self) -> None:
         """Request the experiment wind down (the reference's experiment
@@ -170,6 +176,17 @@ class Orchestrator:
         exp.condition = ExperimentCondition.RUNNING
         obs.experiments_created.inc(algorithm=spec.algorithm.name)
         obs.experiments_current.inc()
+        # open the span journal (append-mode: a resumed experiment continues
+        # from the prior max elapsed offset); tracing is best-effort — an
+        # unwritable workdir must not fail the experiment
+        try:
+            self._tracer = tracing.Tracer(
+                tracing.trace_path(self.workdir, exp.name), experiment=exp.name
+            )
+        except OSError:
+            self._tracer = None
+        self._exp_span_start = self._tracer.elapsed() if self._tracer else 0.0
+        self._prev_tracer = tracing.activate(self._tracer)
         self._publish(exp)
         exhausted = False
         stalled_polls = 0
@@ -243,12 +260,33 @@ class Orchestrator:
                 want = self._shortfall(exp, futures)
                 proposals = []
                 if want > 0 and not exhausted:
+                    sug_start = self._tracer.elapsed() if self._tracer else 0.0
+                    t_sug = time.perf_counter()
+                    outcome = "ok"
                     try:
                         proposals = suggester.get_suggestions(exp, want)
                     except SearchExhausted:
                         exhausted = True
+                        outcome = "exhausted"
                     except SuggestionsNotReady:
-                        pass
+                        outcome = "not_ready"
+                    sug_dur = time.perf_counter() - t_sug
+                    obs.suggestion_latency.observe(
+                        sug_dur, algorithm=spec.algorithm.name
+                    )
+                    # don't journal the thousands of sub-ms not-ready polls a
+                    # rung-gated suggester (Hyperband/ENAS) answers per trial
+                    if self._tracer is not None and (
+                        proposals or outcome == "exhausted" or sug_dur >= 1e-3
+                    ):
+                        self._tracer.record(
+                            "suggest",
+                            sug_start,
+                            sug_dur,
+                            algorithm=spec.algorithm.name,
+                            count=len(proposals),
+                            outcome=outcome,
+                        )
                     for proposal in proposals:
                         trial = self._materialize(exp, proposal, early_stopper, suggester)
                         futures[pool.submit(self._execute, exp, trial, mesh)] = trial
@@ -364,7 +402,17 @@ class Orchestrator:
     DEVICES_LABEL = _DEVICES_LABEL
 
     def _execute(self, exp: Experiment, trial: Trial, mesh):
-        # invariant: never raises — _harvest calls f.result() bare
+        # invariant: never raises — _harvest calls f.result() bare.
+        # Runs on a pool thread: adopt the experiment tracer as this thread's
+        # ambient tracer so runner/NAS spans land in the same journal, and
+        # bracket the whole attempt in a "trial" span.
+        with tracing.use_tracer(self._tracer):
+            with tracing.span("trial", trial=trial.name) as sp:
+                result = self._execute_inner(exp, trial, mesh)
+                sp.set(condition=result.condition.value)
+                return result
+
+    def _execute_inner(self, exp: Experiment, trial: Trial, mesh):
         if self.slice_allocator is not None and mesh is None:
             try:
                 kwargs = {}
@@ -449,7 +497,32 @@ class Orchestrator:
             obs.experiments_failed.inc(algorithm=exp.spec.algorithm.name)
         else:
             obs.experiments_succeeded.inc(algorithm=exp.spec.algorithm.name)
+        duration = (exp.completion_time or time.time()) - exp.start_time
+        obs.experiment_duration.observe(
+            max(duration, 0.0),
+            algorithm=exp.spec.algorithm.name,
+            condition=exp.condition.value,
+        )
+        tracer, self._tracer = self._tracer, None
+        if tracer is not None:
+            tracer.record(
+                "experiment",
+                self._exp_span_start,
+                tracer.elapsed() - self._exp_span_start,
+                algorithm=exp.spec.algorithm.name,
+                condition=exp.condition.value,
+                trials=len(exp.trials),
+            )
+            tracing.deactivate(self._prev_tracer)
+            tracer.close()
         self._publish(exp)
+
+    @staticmethod
+    def _observe_trial_duration(trial: Trial) -> None:
+        obs.trial_duration.observe(
+            max(trial.completion_time - trial.start_time, 0.0),
+            condition=trial.condition.value,
+        )
 
     _TRIAL_COUNTERS = {
         TrialCondition.SUCCEEDED: obs.trials_succeeded,
@@ -517,6 +590,7 @@ class Orchestrator:
                 trial.condition = TrialCondition.KILLED
                 trial.completion_time = time.time()
                 obs.trials_killed.inc()
+                self._observe_trial_duration(trial)
                 continue
             result = f.result()  # _execute never raises
             trial.condition = result.condition
@@ -534,6 +608,7 @@ class Orchestrator:
             counter = self._TRIAL_COUNTERS.get(trial.condition)
             if counter is not None:
                 counter.inc()
+            self._observe_trial_duration(trial)
             self._cleanup_trial(trial)
             exp.update_optimal()
         if done:

@@ -39,6 +39,7 @@ from katib_tpu.earlystop.rules import RuleEvaluator
 from katib_tpu.runner.context import TrialContext, TrialEarlyStopped
 from katib_tpu.runner.metrics import parse_json_lines, parse_text_lines_fast
 from katib_tpu.store.base import ObservationStore
+from katib_tpu.utils import tracing
 
 
 class TrialResult:
@@ -111,7 +112,8 @@ def _run_whitebox(
         )
 
     try:
-        trial.spec.train_fn(ctx)
+        with tracing.span("train_fn", trial=trial.name):
+            trial.spec.train_fn(ctx)
     except TrialEarlyStopped as e:
         if evaluator.triggered is not None:
             return TrialResult(TrialCondition.EARLY_STOPPED, str(e))
@@ -383,6 +385,7 @@ def _run_blackbox(
         )
     except OSError as e:
         return TrialResult(TrialCondition.FAILED, f"failed to launch {argv[0]}: {e}")
+    launched_at = time.perf_counter()
 
     # metrics come from exactly one source: the file when configured, else
     # stdout (no double-reporting); stdout is always drained to avoid blocking
@@ -429,6 +432,9 @@ def _run_blackbox(
             break
         time.sleep(0.05)
     rc = proc.wait()
+    tracing.record_span(
+        "subprocess", time.perf_counter() - launched_at, trial=trial.name, rc=rc
+    )
 
     # final sweep for lines written right before exit (including a last line
     # with no trailing newline); the reader thread must reach EOF first or

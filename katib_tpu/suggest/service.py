@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -60,6 +61,8 @@ from katib_tpu.suggest.base import (
     make_suggester,
     register,
 )
+from katib_tpu.utils import observability as obs
+from katib_tpu.utils import tracing
 
 # ---------------------------------------------------------------------------
 # wire format (flat dict shapes; spec side reuses sdk.yaml_spec's parser)
@@ -353,14 +356,24 @@ class SuggestionService:
                 # retried delivery of a request already applied: replay the
                 # stored reply, do not advance suggester state again
                 return entry.last_response
+            # server-side latency: the algorithm's own think time, without
+            # the client's HTTP round-trip (which the orchestrator measures)
+            t_sug = time.perf_counter()
             try:
-                proposals = entry.suggester.get_suggestions(exp, count)
+                with tracing.span(
+                    "suggest.service", algorithm=spec.algorithm.name, count=count
+                ):
+                    proposals = entry.suggester.get_suggestions(exp, count)
             except SuggestionsNotReady as e:
                 return 409, {"error": str(e), "code": "not_ready"}
             except SearchExhausted as e:
                 return 410, {"error": str(e), "code": "exhausted"}
             except SuggesterError as e:
                 return 400, {"error": str(e)}
+            finally:
+                obs.suggestion_latency.observe(
+                    time.perf_counter() - t_sug, algorithm=spec.algorithm.name
+                )
             response = (
                 200,
                 {

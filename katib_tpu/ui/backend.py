@@ -280,6 +280,14 @@ class UiServer:
     def experiments(self):
         return 200, [_experiment_summary(s) for s in list_statuses(self.workdir)]
 
+    def status(self):
+        """Live in-process metrics snapshot (counters, gauges, histogram
+        aggregates) — the dashboard's counter strip reads this instead of
+        scraping the Prometheus endpoint separately."""
+        from katib_tpu.utils.observability import REGISTRY
+
+        return 200, {"workdir": self.workdir, "metrics": REGISTRY.snapshot()}
+
     def experiment(self, name: str):
         status = read_status(self.workdir, name)
         if status is None:
@@ -370,6 +378,8 @@ class UiServer:
             return 404, {"error": "not found"}
         if parts[1:] == ["flagship", "progress"]:
             return self.flagship_progress()
+        if parts[1:] == ["status"]:
+            return self.status()
         if parts[1:] == ["experiments"]:
             return self.experiments()
         if len(parts) >= 3 and parts[1] == "experiment":
@@ -530,6 +540,7 @@ tr.sel{background:#eef4ff} tbody tr{cursor:pointer}
 #detail{margin-top:1rem} pre{background:#272822;color:#f8f8f2;padding:1rem;overflow:auto;font-size:.8rem}
 </style></head><body>
 <h1>katib-tpu experiments</h1>
+<div id="counters" style="margin:.2rem 0 .8rem;color:#555"></div>
 <details id="create"><summary>create experiment</summary>
 <fieldset style="border:1px solid #ddd;margin:.5rem 0;padding:.6rem">
 <legend>wizard (fills the YAML below — edit freely before running)</legend>
@@ -583,8 +594,24 @@ async function flagshipRuns(){
       `<svg width="${W}" height="${H}"><polyline points="${pts}" fill="none" stroke="#15c" stroke-width="2"/></svg></div>`;
   }).join('');
 }
+async function counters(){
+  // live registry snapshot from this server process (/api/status) — no
+  // separate Prometheus scrape needed for the counter strip
+  const s=await j('/api/status');const m=s.metrics||{};
+  const tot=n=>m[n]?m[n].total:0;
+  const dur=m['katib_trial_duration_seconds'];
+  const mean=dur&&dur.total?(dur.samples.reduce((a,x)=>a+x.sum,0)/dur.total):null;
+  document.getElementById('counters').innerHTML=
+    `<small>trials: ${tot('katib_trial_created_total')} created · `+
+    `${tot('katib_trial_succeeded_total')} succeeded · `+
+    `${tot('katib_trial_failed_total')} failed · `+
+    `${tot('katib_trial_early_stopped_total')} early-stopped · `+
+    `experiments running: ${tot('katib_experiments_current')}`+
+    (mean!==null?` · mean trial ${mean.toFixed(1)}s`:'')+'</small>';
+}
 async function refresh(){
   flagshipRuns().catch(()=>{});
+  counters().catch(()=>{});
   const exps=await j('/api/experiments');
   document.querySelector('#exps tbody').innerHTML=exps.map(e=>{
     const c=e.counts||{},o=e.optimal,n=encodeURIComponent(e.name);

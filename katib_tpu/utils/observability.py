@@ -8,13 +8,35 @@ Parity with the reference controller's Prometheus instrumentation
 dependency: a tiny thread-safe registry with text exposition and an optional
 ``/metrics`` HTTP endpoint.  The orchestrator increments these; anything
 that scrapes Prometheus text format can consume them.
+
+Beyond the reference set this registry also carries latency histograms
+(``_bucket``/``_sum``/``_count`` exposition) and device telemetry gauges —
+the aggregate view that pairs with the per-span journal in
+``utils.tracing``.
 """
 
 from __future__ import annotations
 
+import bisect
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Iterable
+
+
+def _escape_label_value(v: str) -> str:
+    """Text exposition format: backslash, double-quote, and newline must be
+    escaped inside label values or they corrupt the scrape output."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _format_labels(labels: dict[str, str]) -> str:
+    return ",".join(
+        f'{k}="{_escape_label_value(str(v))}"' for k, v in sorted(labels.items())
+    )
+
+
+def _format_value(value: float) -> str:
+    return f"{value:g}"
 
 
 class _Metric:
@@ -48,6 +70,151 @@ class _Metric:
         with self._lock:
             return [(dict(k), v) for k, v in self._values.items()]
 
+    def render_samples(self) -> list[str]:
+        samples = self.samples()
+        if not samples:
+            return [f"{self.name} 0"]
+        lines = []
+        for labels, value in samples:
+            if labels:
+                lines.append(
+                    f"{self.name}{{{_format_labels(labels)}}} {_format_value(value)}"
+                )
+            else:
+                lines.append(f"{self.name} {_format_value(value)}")
+        return lines
+
+    def snapshot(self) -> dict:
+        samples = [
+            {"labels": labels, "value": value} for labels, value in self.samples()
+        ]
+        return {
+            "kind": self.kind,
+            "help": self.help,
+            "total": sum(s["value"] for s in samples),
+            "samples": samples,
+        }
+
+
+# Default bucket boundaries span sub-millisecond suggestion calls through
+# multi-minute trials (seconds).
+DEFAULT_BUCKETS = (
+    0.001,
+    0.005,
+    0.01,
+    0.05,
+    0.1,
+    0.25,
+    0.5,
+    1.0,
+    2.5,
+    5.0,
+    10.0,
+    30.0,
+    60.0,
+    120.0,
+    300.0,
+    600.0,
+)
+
+
+class _Histogram(_Metric):
+    """Prometheus histogram: per-series bucket counts + sum + count, rendered
+    as cumulative ``_bucket{le=...}`` / ``_sum`` / ``_count`` series."""
+
+    def __init__(
+        self,
+        name: str,
+        help_text: str,
+        buckets: tuple[float, ...] = DEFAULT_BUCKETS,
+    ):
+        super().__init__(name, help_text, "histogram")
+        self.buckets = tuple(sorted(buckets))
+        # per label-key: [bucket counts (len+1, last = +Inf overflow), sum, count]
+        self._series: dict[tuple[tuple[str, str], ...], list] = {}
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = self._key(labels)
+        idx = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            series = self._series.get(key)
+            if series is None:
+                series = [[0] * (len(self.buckets) + 1), 0.0, 0]
+                self._series[key] = series
+            series[0][idx] += 1
+            series[1] += value
+            series[2] += 1
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        raise TypeError(f"histogram {self.name} supports observe(), not inc()")
+
+    set = inc  # type: ignore[assignment]
+
+    def get_count(self, **labels: str) -> int:
+        with self._lock:
+            series = self._series.get(self._key(labels))
+            return series[2] if series else 0
+
+    def get_sum(self, **labels: str) -> float:
+        with self._lock:
+            series = self._series.get(self._key(labels))
+            return series[1] if series else 0.0
+
+    def samples(self) -> Iterable[tuple[dict[str, str], float]]:
+        # "samples" for a histogram = per-series observation counts; the
+        # full bucket detail lives in render_samples()/snapshot().
+        with self._lock:
+            return [(dict(k), float(s[2])) for k, s in self._series.items()]
+
+    def _snapshot_series(self) -> list[tuple[dict[str, str], list[int], float, int]]:
+        with self._lock:
+            return [
+                (dict(k), list(s[0]), s[1], s[2]) for k, s in self._series.items()
+            ]
+
+    def render_samples(self) -> list[str]:
+        series = self._snapshot_series()
+        if not series:
+            # expose empty bucket/sum/count series so scrapers see the metric
+            series = [({}, [0] * (len(self.buckets) + 1), 0.0, 0)]
+        lines = []
+        for labels, counts, total, count in series:
+            cumulative = 0
+            for bound, c in zip(self.buckets, counts):
+                cumulative += c
+                le_labels = dict(labels)
+                le_labels["le"] = _format_value(bound)
+                lines.append(
+                    f"{self.name}_bucket{{{_format_labels(le_labels)}}} {cumulative}"
+                )
+            cumulative += counts[-1]
+            inf_labels = dict(labels)
+            inf_labels["le"] = "+Inf"
+            lines.append(
+                f"{self.name}_bucket{{{_format_labels(inf_labels)}}} {cumulative}"
+            )
+            suffix = f"{{{_format_labels(labels)}}}" if labels else ""
+            lines.append(f"{self.name}_sum{suffix} {_format_value(total)}")
+            lines.append(f"{self.name}_count{suffix} {count}")
+        return lines
+
+    def snapshot(self) -> dict:
+        series = self._snapshot_series()
+        return {
+            "kind": self.kind,
+            "help": self.help,
+            "total": sum(count for _, _, _, count in series),
+            "samples": [
+                {
+                    "labels": labels,
+                    "count": count,
+                    "sum": total,
+                    "mean": (total / count) if count else 0.0,
+                }
+                for labels, _, total, count in series
+            ],
+        }
+
 
 class MetricsRegistry:
     def __init__(self) -> None:
@@ -59,6 +226,21 @@ class MetricsRegistry:
 
     def gauge(self, name: str, help_text: str = "") -> _Metric:
         return self._register(name, help_text, "gauge")
+
+    def histogram(
+        self,
+        name: str,
+        help_text: str = "",
+        buckets: tuple[float, ...] = DEFAULT_BUCKETS,
+    ) -> _Histogram:
+        with self._lock:
+            metric = self._metrics.get(name)
+            if metric is None:
+                metric = _Histogram(name, help_text, buckets)
+                self._metrics[name] = metric
+            if not isinstance(metric, _Histogram):
+                raise TypeError(f"metric {name} already registered as {metric.kind}")
+            return metric
 
     def _register(self, name: str, help_text: str, kind: str) -> _Metric:
         with self._lock:
@@ -77,19 +259,15 @@ class MetricsRegistry:
             if m.help:
                 lines.append(f"# HELP {m.name} {m.help}")
             lines.append(f"# TYPE {m.name} {m.kind}")
-            samples = m.samples()
-            if not samples:
-                lines.append(f"{m.name} 0")
-                continue
-            for labels, value in samples:
-                if labels:
-                    label_str = ",".join(
-                        f'{k}="{v}"' for k, v in sorted(labels.items())
-                    )
-                    lines.append(f"{m.name}{{{label_str}}} {value:g}")
-                else:
-                    lines.append(f"{m.name} {value:g}")
+            lines.extend(m.render_samples())
         return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> dict[str, dict]:
+        """JSON-friendly view of every metric — served by the UI backend so
+        the dashboard shows counters without a separate Prometheus scrape."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return {m.name: m.snapshot() for m in metrics}
 
     def serve(self, port: int = 0, host: str = "127.0.0.1") -> "MetricsServer":
         """Expose ``/metrics`` on a daemon thread; returns a stoppable handle
@@ -97,7 +275,7 @@ class MetricsRegistry:
         registry = self
 
         class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802 (http.server API)
+            def _respond(self, include_body: bool) -> None:
                 if self.path not in ("/metrics", "/"):
                     self.send_response(404)
                     self.end_headers()
@@ -107,7 +285,26 @@ class MetricsRegistry:
                 self.send_header("Content-Type", "text/plain; version=0.0.4")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
-                self.wfile.write(body)
+                if include_body:
+                    self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 (http.server API)
+                self._respond(include_body=True)
+
+            def do_HEAD(self):  # noqa: N802 — probes HEAD before scraping
+                self._respond(include_body=False)
+
+            def _method_not_allowed(self):
+                self.send_response(405)
+                self.send_header("Allow", "GET, HEAD")
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            do_POST = _method_not_allowed  # noqa: N815 (http.server API)
+            do_PUT = _method_not_allowed  # noqa: N815
+            do_DELETE = _method_not_allowed  # noqa: N815
+            do_PATCH = _method_not_allowed  # noqa: N815
+            do_OPTIONS = _method_not_allowed  # noqa: N815
 
             def log_message(self, *args):  # silence per-request stderr noise
                 pass
@@ -163,3 +360,53 @@ trials_metrics_unavailable = REGISTRY.counter(
     "katib_trial_metrics_unavailable_total",
     "Trials finishing without reporting the objective metric",
 )
+
+# -- latency distributions + device telemetry ---------------------------------
+
+experiment_duration = REGISTRY.histogram(
+    "katib_experiment_duration_seconds",
+    "Wall-clock duration of completed experiments",
+)
+trial_duration = REGISTRY.histogram(
+    "katib_trial_duration_seconds",
+    "Wall-clock duration of completed trials",
+)
+suggestion_latency = REGISTRY.histogram(
+    "katib_suggestion_latency_seconds",
+    "Latency of suggester get_suggestions calls",
+)
+trial_step_seconds = REGISTRY.histogram(
+    "katib_trial_step_seconds",
+    "Per-step (or per-epoch-averaged) training step time",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0),
+)
+trial_first_step_seconds = REGISTRY.gauge(
+    "katib_trial_first_step_seconds",
+    "First-step latency split into compile vs execute (phase label)",
+)
+trial_images_per_second = REGISTRY.gauge(
+    "katib_trial_images_per_second",
+    "Training throughput of the most recent epoch",
+)
+device_hbm_bytes = REGISTRY.gauge(
+    "katib_device_hbm_bytes_in_use",
+    "Per-device bytes in use (jax device memory_stats, where available)",
+)
+
+
+def record_device_memory(registry_gauge: _Metric | None = None) -> None:
+    """Best-effort per-device memory gauges via ``Device.memory_stats()``
+    (TPU/GPU backends expose ``bytes_in_use``; CPU usually returns None)."""
+    gauge = registry_gauge or device_hbm_bytes
+    try:
+        import jax
+
+        for d in jax.local_devices():
+            stats = getattr(d, "memory_stats", lambda: None)()
+            if not stats:
+                continue
+            in_use = stats.get("bytes_in_use")
+            if in_use is not None:
+                gauge.set(float(in_use), device=str(d.id), kind=d.platform)
+    except Exception:
+        pass  # telemetry only — never break a training loop

@@ -80,6 +80,118 @@ class TestParsePrometheusText:
         assert dedup(text) == []      # unchanged snapshot: nothing re-emitted
 
 
+class TestMetricsRegistry:
+    def test_label_value_escaping(self):
+        """Backslash, double-quote, and newline in label values must be
+        escaped per the text exposition format (they corrupt the scrape
+        output otherwise)."""
+        from katib_tpu.utils.observability import MetricsRegistry
+
+        reg = MetricsRegistry()
+        g = reg.gauge("escape_test", "esc")
+        g.set(1.0, path='a\\b"c\nd')
+        line = [l for l in reg.render().splitlines() if l.startswith("escape_test{")][0]
+        assert line == 'escape_test{path="a\\\\b\\"c\\nd"} 1'
+
+    def test_histogram_exposition_roundtrip(self):
+        """Histogram renders cumulative _bucket/_sum/_count series that the
+        repo's own Prometheus parser scrapes back."""
+        from katib_tpu.runner.metrics import parse_prometheus_text
+        from katib_tpu.utils.observability import MetricsRegistry
+
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_seconds", "latency", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0, 100.0):
+            h.observe(v, op="x")
+        text = reg.render()
+        assert "# TYPE lat_seconds histogram" in text
+        assert 'lat_seconds_bucket{le="0.1",op="x"} 1' in text
+        assert 'lat_seconds_bucket{le="1",op="x"} 3' in text
+        assert 'lat_seconds_bucket{le="10",op="x"} 4' in text
+        assert 'lat_seconds_bucket{le="+Inf",op="x"} 5' in text
+        assert 'lat_seconds_count{op="x"} 5' in text
+        assert h.get_count(op="x") == 5
+        assert abs(h.get_sum(op="x") - 106.05) < 1e-9
+        logs = parse_prometheus_text(
+            text, ["lat_seconds_bucket", "lat_seconds_sum", "lat_seconds_count"]
+        )
+        by_name = {}
+        for l in logs:
+            by_name.setdefault(l.metric_name, []).append(l.value)
+        assert by_name["lat_seconds_bucket"] == [1, 3, 4, 5]
+        assert by_name["lat_seconds_count"] == [5]
+        assert abs(by_name["lat_seconds_sum"][0] - 106.05) < 1e-9
+
+    def test_empty_histogram_still_exposed(self):
+        """Scrapers must see the series (zero count) before any observation."""
+        from katib_tpu.utils.observability import MetricsRegistry
+
+        reg = MetricsRegistry()
+        reg.histogram("idle_seconds", buckets=(1.0,))
+        text = reg.render()
+        assert 'idle_seconds_bucket{le="+Inf"} 0' in text
+        assert "idle_seconds_count 0" in text
+
+    def test_histogram_rejects_counter_api(self):
+        import pytest
+
+        from katib_tpu.utils.observability import MetricsRegistry
+
+        reg = MetricsRegistry()
+        h = reg.histogram("h_seconds")
+        with pytest.raises(TypeError):
+            h.inc()
+        reg.gauge("plain")
+        with pytest.raises(TypeError):
+            reg.histogram("plain")  # name already bound to a gauge
+
+    def test_snapshot(self):
+        from katib_tpu.utils.observability import MetricsRegistry
+
+        reg = MetricsRegistry()
+        reg.counter("c_total").inc(algorithm="tpe")
+        reg.counter("c_total").inc(algorithm="random")
+        reg.histogram("h_seconds").observe(2.0)
+        snap = reg.snapshot()
+        assert snap["c_total"]["total"] == 2
+        assert snap["h_seconds"]["total"] == 1
+        assert snap["h_seconds"]["samples"][0]["mean"] == 2.0
+
+
+class TestMetricsEndpoint:
+    def test_head_and_405(self):
+        """Standard scrapers probe HEAD first; non-GET methods must get an
+        explicit 405, not a silent 404."""
+        import urllib.error
+        import urllib.request
+
+        from katib_tpu.utils.observability import MetricsRegistry
+
+        reg = MetricsRegistry()
+        reg.counter("probe_total", "probe").inc()
+        server = reg.serve(port=0)
+        try:
+            base = f"http://127.0.0.1:{server.port}/metrics"
+            body = urllib.request.urlopen(base, timeout=5).read().decode()
+            assert "probe_total 1" in body
+
+            head = urllib.request.Request(base, method="HEAD")
+            resp = urllib.request.urlopen(head, timeout=5)
+            assert resp.status == 200
+            assert resp.read() == b""
+            assert int(resp.headers["Content-Length"]) > 0
+
+            post = urllib.request.Request(base, data=b"x", method="POST")
+            try:
+                urllib.request.urlopen(post, timeout=5)
+                raise AssertionError("POST should be rejected")
+            except urllib.error.HTTPError as e:
+                assert e.code == 405
+                assert "GET" in e.headers.get("Allow", "")
+        finally:
+            server.stop()
+
+
 def _free_port() -> int:
     s = socket.socket()
     s.bind(("127.0.0.1", 0))

@@ -1,0 +1,218 @@
+"""Span tracing: journal semantics, restart resume, Chrome-trace export, and
+the orchestrator producing matching spans for every trial of a CPU run."""
+
+import json
+import os
+
+from katib_tpu.core.types import (
+    AlgorithmSpec,
+    ExperimentSpec,
+    FeasibleSpace,
+    ObjectiveSpec,
+    ObjectiveType,
+    ParameterSpec,
+    ParameterType,
+)
+from katib_tpu.utils import tracing
+
+
+class TestTracer:
+    def test_span_records_jsonl(self, tmp_path):
+        path = tracing.trace_path(str(tmp_path), "exp")
+        tracer = tracing.Tracer(path, experiment="exp")
+        with tracer.span("work", trial="t1") as sp:
+            sp.set(condition="Succeeded")
+        tracer.close()
+        (rec,) = tracing.read_journal(path)
+        assert rec["name"] == "work"
+        assert rec["dur"] >= 0
+        assert rec["args"] == {
+            "trial": "t1",
+            "condition": "Succeeded",
+            "experiment": "exp",
+        }
+
+    def test_span_tags_error_and_reraises(self, tmp_path):
+        path = str(tmp_path / "t.jsonl")
+        tracer = tracing.Tracer(path)
+        try:
+            with tracer.span("boom"):
+                raise ValueError("x")
+        except ValueError:
+            pass
+        tracer.close()
+        (rec,) = tracing.read_journal(path)
+        assert rec["args"]["error"] == "ValueError"
+
+    def test_resume_continues_elapsed_base(self, tmp_path):
+        """A reopened journal appends with ts past the prior max(ts+dur) —
+        the restart-safe monotonic base (darts elapsed_s pattern)."""
+        path = str(tmp_path / "t.jsonl")
+        t1 = tracing.Tracer(path)
+        t1.record("first", 0.0, 5.0)
+        t1.close()
+        t2 = tracing.Tracer(path)
+        with t2.span("second"):
+            pass
+        t2.close()
+        first, second = tracing.read_journal(path)
+        assert second["ts"] >= first["ts"] + first["dur"] - 1e-6
+
+    def test_corrupt_lines_skipped(self, tmp_path):
+        path = str(tmp_path / "t.jsonl")
+        with open(path, "w") as f:
+            f.write('{"name": "ok", "ts": 0.0, "dur": 1.0}\n')
+            f.write("{torn half-wri\n")
+            f.write("null\n")
+        assert [r["name"] for r in tracing.read_journal(path)] == ["ok"]
+        t = tracing.Tracer(path)  # resume over the corrupt tail must not raise
+        t.close()
+
+    def test_ambient_tracer_noop_without_activation(self, tmp_path):
+        # must not raise, and sp.set must be absorbed
+        with tracing.span("orphan") as sp:
+            sp.set(x=1)
+        tracing.record_span("orphan", 0.1)
+        path = str(tmp_path / "t.jsonl")
+        tracer = tracing.Tracer(path)
+        with tracing.use_tracer(tracer):
+            assert tracing.current_tracer() is tracer
+            with tracing.span("seen"):
+                pass
+            tracing.record_span("timed", 0.25, tag="x")
+        assert tracing.current_tracer() is None
+        tracer.close()
+        recs = tracing.read_journal(path)
+        assert [r["name"] for r in recs] == ["seen", "timed"]
+        assert abs(recs[1]["dur"] - 0.25) < 1e-6
+
+
+class TestChromeTraceExport:
+    def test_export_validity(self, tmp_path):
+        path = str(tmp_path / "t.jsonl")
+        tracer = tracing.Tracer(path, experiment="e")
+        with tracer.span("a", trial="t1"):
+            pass
+        tracer.record("b", 1.0, 2.5, step=3)
+        tracer.close()
+        out = str(tmp_path / "trace.json")
+        assert tracing.export_chrome_trace(path, out) == 2
+        doc = json.loads(open(out).read())
+        assert doc["displayTimeUnit"] == "ms"
+        events = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert len(events) == 2
+        for e in events:
+            assert set(e) >= {"name", "ph", "ts", "dur", "pid", "tid", "args"}
+            assert e["ts"] >= 0 and e["dur"] >= 0
+        b = next(e for e in events if e["name"] == "b")
+        assert b["ts"] == 1.0e6 and b["dur"] == 2.5e6
+        # metadata rows label the emitting process
+        assert any(e["ph"] == "M" for e in doc["traceEvents"])
+
+    def test_export_empty_journal(self, tmp_path):
+        out = str(tmp_path / "trace.json")
+        assert tracing.export_chrome_trace(str(tmp_path / "missing.jsonl"), out) == 0
+        assert not os.path.exists(out)
+
+    def test_summarize(self):
+        recs = [
+            {"name": "a", "ts": 0, "dur": 1.0},
+            {"name": "a", "ts": 1, "dur": 3.0},
+            {"name": "b", "ts": 2, "dur": 0.5},
+        ]
+        summary = tracing.summarize(recs)
+        assert [s["name"] for s in summary] == ["a", "b"]  # by total desc
+        a = summary[0]
+        assert a["count"] == 2 and a["total_s"] == 4.0 and a["mean_s"] == 2.0
+        assert a["max_s"] == 3.0
+
+
+def _spec(name: str, n_trials: int = 3) -> ExperimentSpec:
+    def train_fn(ctx):
+        ctx.report(accuracy=float(ctx.params["x"]))
+
+    return ExperimentSpec(
+        name=name,
+        algorithm=AlgorithmSpec(name="random"),
+        objective=ObjectiveSpec(
+            type=ObjectiveType.MAXIMIZE, objective_metric_name="accuracy"
+        ),
+        parameters=[
+            ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min="0", max="1"))
+        ],
+        max_trial_count=n_trials,
+        parallel_trial_count=2,
+        train_fn=train_fn,
+    )
+
+
+class TestOrchestratorTracing:
+    def test_every_trial_has_a_span(self, tmp_path):
+        from katib_tpu.orchestrator.orchestrator import Orchestrator
+        from katib_tpu.utils import observability as obs
+
+        orch = Orchestrator(workdir=str(tmp_path))
+        exp = orch.run(_spec("trace-e2e"))
+        assert exp.condition.is_terminal()
+
+        journal = tracing.trace_path(str(tmp_path), "trace-e2e")
+        recs = tracing.read_journal(journal)
+        trial_spans = {
+            r["args"]["trial"]: r for r in recs if r["name"] == "trial"
+        }
+        # one complete (start+end → single "X" record) span per trial
+        assert set(trial_spans) == set(exp.trials)
+        for name, rec in trial_spans.items():
+            assert rec["dur"] >= 0 and rec["ts"] >= 0
+            assert rec["args"]["condition"] == exp.trials[name].condition.value
+            assert rec["args"]["experiment"] == "trace-e2e"
+        # train_fn spans nest inside trial spans (whitebox path)
+        assert sum(1 for r in recs if r["name"] == "train_fn") == len(exp.trials)
+        # suggestion-service spans + the terminal experiment span
+        assert any(r["name"] == "suggest" for r in recs)
+        exp_spans = [r for r in recs if r["name"] == "experiment"]
+        assert len(exp_spans) == 1
+        assert exp_spans[0]["args"]["trials"] == len(exp.trials)
+        # ambient tracer is cleaned up after the run
+        assert tracing.current_tracer() is None
+
+        # exported Chrome trace is valid and complete
+        out = str(tmp_path / "trace.json")
+        assert tracing.export_chrome_trace(journal, out) == len(recs)
+        doc = json.loads(open(out).read())
+        names = [e["name"] for e in doc["traceEvents"]]
+        assert "experiment" in names and "trial" in names
+
+        # duration histograms on the global registry (cross-test counts can
+        # only grow, so assert >= via the rendered series)
+        text = obs.REGISTRY.render()
+        assert "katib_trial_duration_seconds_bucket" in text
+        assert "katib_suggestion_latency_seconds_bucket" in text
+        assert obs.trial_duration.get_count(condition="Succeeded") >= len(exp.trials)
+
+    def test_journal_survives_resume(self, tmp_path):
+        """A resumed experiment appends to the same journal with a monotonic
+        elapsed base: a second experiment span lands after the first."""
+        from katib_tpu.core.types import ResumePolicy
+        from katib_tpu.orchestrator.orchestrator import Orchestrator
+
+        spec = _spec("trace-resume", n_trials=2)
+        spec.resume_policy = ResumePolicy.LONG_RUNNING
+        orch = Orchestrator(workdir=str(tmp_path))
+        orch.run(spec)
+
+        spec2 = _spec("trace-resume", n_trials=4)
+        spec2.resume_policy = ResumePolicy.LONG_RUNNING
+        orch2 = Orchestrator(workdir=str(tmp_path))
+        exp2 = orch2.run(spec2, resume=True)
+        assert len(exp2.trials) == 4
+
+        recs = tracing.read_journal(tracing.trace_path(str(tmp_path), "trace-resume"))
+        exp_spans = [r for r in recs if r["name"] == "experiment"]
+        assert len(exp_spans) == 2
+        # second run's span starts at or after the first run's span end
+        assert (
+            exp_spans[1]["ts"]
+            >= exp_spans[0]["ts"] + exp_spans[0]["dur"] - 1e-6
+        )
+        assert len([r for r in recs if r["name"] == "trial"]) == 4
