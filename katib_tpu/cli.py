@@ -15,6 +15,7 @@ observation store:
 - ``suggest-server``          suggestion-as-a-service daemon
 - ``db-manager``              native observation-log daemon (``--db`` = durable journal)
 - ``conformance``             packaged e2e invariants check (conformance/run.sh parity)
+- ``chaos``                   deterministic fault-injection run (fault-tolerance invariants)
 - ``doctor``                  environment report (devices, native runtime)
 """
 
@@ -331,6 +332,126 @@ def cmd_conformance(args: argparse.Namespace) -> int:
         f"CONFORMANCE PASS: {exp.condition.value}, "
         f"{exp.completed_count} trials, best={exp.optimal.objective_value:.4f}"
     )
+    return 0
+
+
+def cmd_chaos(args: argparse.Namespace) -> int:
+    """Deterministic fault-injection run: a seeded ``FaultInjector`` plants
+    transient trial failures and suggester exceptions in a small white-box
+    experiment, then the exit status asserts the fault-tolerance invariants
+    (transient retries recover with checkpoint resume, permanent failures
+    don't retry, the suggester circuit breaker absorbs sub-threshold errors).
+    The chaos analog of ``conformance``: same experiment, hostile weather."""
+    import tempfile
+
+    from katib_tpu.core.types import (
+        AlgorithmSpec,
+        ExperimentCondition,
+        ExperimentSpec,
+        FeasibleSpace,
+        ObjectiveSpec,
+        ObjectiveType,
+        ParameterSpec,
+        ParameterType,
+        TrialCondition,
+    )
+    from katib_tpu.orchestrator import Orchestrator
+    from katib_tpu.utils import observability as obs
+    from katib_tpu.utils.faults import FailureKind, FaultInjector
+
+    injector = FaultInjector(seed=args.seed)
+    for spec_str in args.fail_trial or []:
+        parts = spec_str.split(":")
+        if len(parts) not in (2, 3):
+            print(f"bad --fail-trial {spec_str!r} (want K:J[:kind])", file=sys.stderr)
+            return 2
+        kind = FailureKind(parts[2].capitalize()) if len(parts) == 3 else FailureKind.TRANSIENT
+        injector.fail_trial(int(parts[0]), int(parts[1]), kind)
+    for call in args.fail_suggester or []:
+        injector.fail_suggester(int(call))
+    if args.flake_rate:
+        injector.flake(args.flake_rate)
+    if not injector.log and not (args.fail_trial or args.fail_suggester or args.flake_rate):
+        # default scenario: first trial is preempted twice, one suggester
+        # call blows up — the experiment must shrug all of it off
+        injector.fail_trial(0, 1).fail_trial(0, 2).fail_suggester(2)
+
+    def trainer(ctx):
+        # checkpoint-aware: progress survives transient retries because the
+        # re-run reuses the same checkpoint dir
+        os.makedirs(ctx.checkpoint_dir, exist_ok=True)
+        marker = os.path.join(ctx.checkpoint_dir, "progress.txt")
+        start = 0
+        if os.path.exists(marker):
+            with open(marker) as f:
+                start = int(f.read().strip() or 0)
+        x = float(ctx.params["lr"])
+        for step in range(start, 3):
+            with open(marker, "w") as f:
+                f.write(str(step + 1))
+            if not ctx.report(step=step, accuracy=(1.0 - 0.2 * (x - 0.05) ** 2) * (step + 1) / 3):
+                return
+
+    spec = ExperimentSpec(
+        name="chaos-random",
+        algorithm=AlgorithmSpec(name="random", settings={"seed": str(args.seed)}),
+        objective=ObjectiveSpec(
+            type=ObjectiveType.MAXIMIZE, objective_metric_name="accuracy"
+        ),
+        parameters=[
+            ParameterSpec("lr", ParameterType.DOUBLE, FeasibleSpace(min=0.01, max=0.2)),
+        ],
+        max_trial_count=args.trials,
+        parallel_trial_count=1,  # keeps injector trial indices deterministic
+        max_retries=args.max_retries,
+        retry_backoff_seconds=0.05,
+        suggester_max_errors=args.suggester_max_errors,
+        train_fn=trainer,
+    )
+    errors_before = obs.suggester_errors.get(algorithm="random")
+    retried_before = obs.trials_retried.get(kind=FailureKind.TRANSIENT.value)
+    with tempfile.TemporaryDirectory(prefix="katib-chaos-") as workdir:
+        exp = Orchestrator(workdir=workdir, fault_injector=injector).run(spec)
+
+    print(f"chaos seed={args.seed}  experiment={exp.condition.value}")
+    for t in sorted(exp.trials.values(), key=lambda t: t.start_time):
+        print(
+            f"  {t.name}: {t.condition.value:<20} attempts={t.retry_count + 1} "
+            f"kind={t.failure_kind or '-'}"
+        )
+    print(
+        f"injected: {len(injector.log)} faults; "
+        f"retries={obs.trials_retried.get(kind=FailureKind.TRANSIENT.value) - retried_before:g}; "
+        f"suggester errors absorbed={obs.suggester_errors.get(algorithm='random') - errors_before:g}"
+    )
+
+    failures = []
+    if not exp.condition.is_terminal():
+        failures.append(f"experiment not terminal: {exp.condition.value}")
+    if exp.condition is ExperimentCondition.FAILED:
+        failures.append(f"experiment failed: {exp.message.splitlines()[0] if exp.message else ''}")
+    recovered = [
+        t for t in exp.trials.values()
+        if t.retry_count > 0 and t.condition is TrialCondition.SUCCEEDED
+    ]
+    injected_transient = [
+        e
+        for e in injector.log
+        if e.get("seam") == "trial" and e.get("kind") == FailureKind.TRANSIENT.value
+    ]
+    if injected_transient and args.max_retries > 0 and not recovered:
+        failures.append("no trial recovered from an injected transient fault")
+    never_retried = [
+        t.name
+        for t in exp.trials.values()
+        if t.failure_kind == FailureKind.PERMANENT.value and t.retry_count > 0
+    ]
+    if never_retried:
+        failures.append(f"permanent failures were retried: {never_retried}")
+    if failures:
+        print("CHAOS FAIL: " + "; ".join(failures), file=sys.stderr)
+        return 1
+    print("CHAOS PASS: every injected fault was absorbed")
     return 0
 
 
@@ -653,6 +774,34 @@ def main(argv: list[str] | None = None) -> int:
     p = sub.add_parser("conformance", help="packaged e2e invariants check")
     p.add_argument("--max-trials", type=int, default=8)
     p.set_defaults(fn=cmd_conformance)
+
+    p = sub.add_parser(
+        "chaos", help="deterministic fault-injection run (fault-tolerance invariants)"
+    )
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--trials", type=int, default=4)
+    p.add_argument("--max-retries", type=int, default=3)
+    p.add_argument("--suggester-max-errors", type=int, default=3)
+    p.add_argument(
+        "--fail-trial",
+        action="append",
+        metavar="K:J[:kind]",
+        help="fail trial K's attempt J (0-based trial index, 1-based attempt; "
+        "kind transient|permanent, default transient); repeatable",
+    )
+    p.add_argument(
+        "--fail-suggester",
+        action="append",
+        metavar="N",
+        help="raise inside the N-th (1-based) get_suggestions call; repeatable",
+    )
+    p.add_argument(
+        "--flake-rate",
+        type=float,
+        default=0.0,
+        help="seeded random per-attempt transient failure probability",
+    )
+    p.set_defaults(fn=cmd_chaos)
 
     p = sub.add_parser(
         "db-manager", help="run the native observation-log daemon"

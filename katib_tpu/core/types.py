@@ -533,6 +533,16 @@ class TrialSpec:
     # objective metric (the reference requeues metrics-not-reported trials,
     # ``trial_controller.go:182-185``); 0 = classify immediately
     metrics_retries: int = 0
+    # bounded re-runs after a TRANSIENT failure (preemption,
+    # RESOURCE_EXHAUSTED, OSError family, retryable exit code — see
+    # utils/faults.py); retries reuse the trial's name and checkpoint dir so
+    # a checkpoint-aware train_fn resumes mid-trial.  Permanent failures
+    # (ValueError/assertion/shape errors) never retry.  0 = classify the
+    # first failure immediately
+    max_retries: int = 0
+    # first-retry delay for the shared exponential backoff (doubles per
+    # attempt, jittered, capped at ~30s, stop-event responsive)
+    retry_backoff_seconds: float = 1.0
 
     def params(self) -> dict[str, Any]:
         return assignments_to_dict(self.assignments)
@@ -551,6 +561,13 @@ class Trial:
     start_time: float = 0.0
     completion_time: float = 0.0
     checkpoint_dir: str | None = None
+    # transient-failure retries consumed so far — journaled to status.json so
+    # a resume-after-crash continues with the budget already spent rather
+    # than resetting it (budget math still counts the trial once)
+    retry_count: int = 0
+    # FailureKind value ("Transient"/"Permanent") of the most recent failed
+    # attempt, None while no attempt has failed (or after a later success)
+    failure_kind: str | None = None
 
     def params(self) -> dict[str, Any]:
         return self.spec.params()
@@ -621,6 +638,15 @@ class ExperimentSpec:
     # propagated into every TrialSpec (see TrialSpec for reference parity).
     max_trial_runtime_seconds: float | None = None
     metrics_retries: int = 0
+    # Transient-failure retry budget + backoff base, propagated into every
+    # TrialSpec (see TrialSpec / utils.faults for the taxonomy).
+    max_retries: int = 0
+    retry_backoff_seconds: float = 1.0
+    # Suggester circuit breaker: this many CONSECUTIVE get_suggestions
+    # exceptions fail the experiment with the last traceback; fewer are
+    # counted (katib_suggester_errors_total) and retried after a cooldown
+    # while in-flight trials keep running.
+    suggester_max_errors: int = 5
 
     def parameter(self, name: str) -> ParameterSpec:
         for p in self.parameters:

@@ -125,6 +125,14 @@ def validate_experiment(spec: ExperimentSpec) -> None:
         errors.append("max_trial_count must be >= 1")
     if spec.max_failed_trial_count is not None and spec.max_failed_trial_count < 0:
         errors.append("max_failed_trial_count must be >= 0")
+    if spec.metrics_retries < 0:
+        errors.append("metrics_retries must be >= 0")
+    if spec.max_retries < 0:
+        errors.append("max_retries must be >= 0")
+    if spec.retry_backoff_seconds < 0:
+        errors.append("retry_backoff_seconds must be >= 0")
+    if spec.suggester_max_errors < 1:
+        errors.append("suggester_max_errors must be >= 1")
 
     if spec.train_fn is not None and spec.command is not None:
         errors.append("specify exactly one of train_fn or command, not both")

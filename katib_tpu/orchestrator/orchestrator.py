@@ -45,11 +45,8 @@ from katib_tpu.core.validation import validate_experiment
 from katib_tpu.earlystop.rules import make_early_stopper
 from katib_tpu.runner.trial_runner import TrialResult, run_trial
 from katib_tpu.store.base import MemoryObservationStore, ObservationStore
-from katib_tpu.suggest.base import (
-    SearchExhausted,
-    SuggestionsNotReady,
-    make_suggester,
-)
+from katib_tpu.suggest.base import call_suggester, make_suggester
+from katib_tpu.utils import faults
 from katib_tpu.utils import observability as obs
 from katib_tpu.utils import tracing
 
@@ -63,6 +60,7 @@ class Orchestrator:
         poll_interval: float = 0.02,
         config=None,
         slice_allocator=None,
+        fault_injector: faults.FaultInjector | None = None,
     ):
         self.store = store if store is not None else MemoryObservationStore()
         # a defaulted store may be upgraded to the durable sqlite backend at
@@ -80,6 +78,10 @@ class Orchestrator:
         # analog of the reference resolving KatibConfig at reconcile time
         # (``katibconfig/config.go:60``)
         self.config = config
+        # deterministic chaos harness (utils.faults.FaultInjector): threaded
+        # through the suggester call and every trial attempt so tests and
+        # `katib-tpu chaos` exercise the recovery paths on demand
+        self.fault_injector = fault_injector
         # jax.profiler is a process-global singleton; only one trial may
         # trace at a time — others run unprofiled rather than crash
         self._profile_lock = threading.Lock()
@@ -190,6 +192,11 @@ class Orchestrator:
         self._publish(exp)
         exhausted = False
         stalled_polls = 0
+        # suggester fault isolation: absorb up to suggester_max_errors - 1
+        # CONSECUTIVE get_suggestions exceptions (counted + cooled down with
+        # backoff) while in-flight trials keep running; the Nth trips the
+        # breaker and fails the experiment with the last traceback
+        breaker = faults.CircuitBreaker(threshold=spec.suggester_max_errors)
         futures: dict[cf.Future, Trial] = {}
         # per-run wind-down signal for in-flight trials, set on a terminal
         # verdict or an external stop() (the reference deletes running trial
@@ -259,46 +266,73 @@ class Orchestrator:
 
                 want = self._shortfall(exp, futures)
                 proposals = []
+                suggester_busy = False  # erroring or cooling down, not idle
                 if want > 0 and not exhausted:
-                    sug_start = self._tracer.elapsed() if self._tracer else 0.0
-                    t_sug = time.perf_counter()
-                    outcome = "ok"
-                    try:
-                        proposals = suggester.get_suggestions(exp, want)
-                    except SearchExhausted:
-                        exhausted = True
-                        outcome = "exhausted"
-                    except SuggestionsNotReady:
-                        outcome = "not_ready"
-                    sug_dur = time.perf_counter() - t_sug
-                    obs.suggestion_latency.observe(
-                        sug_dur, algorithm=spec.algorithm.name
-                    )
-                    # don't journal the thousands of sub-ms not-ready polls a
-                    # rung-gated suggester (Hyperband/ENAS) answers per trial
-                    if self._tracer is not None and (
-                        proposals or outcome == "exhausted" or sug_dur >= 1e-3
-                    ):
-                        self._tracer.record(
-                            "suggest",
-                            sug_start,
-                            sug_dur,
-                            algorithm=spec.algorithm.name,
-                            count=len(proposals),
-                            outcome=outcome,
+                    if not breaker.allow():
+                        # bounded retry-with-backoff: skip the call while the
+                        # breaker cools down, keep harvesting in-flight trials
+                        suggester_busy = True
+                    else:
+                        sug_start = self._tracer.elapsed() if self._tracer else 0.0
+                        t_sug = time.perf_counter()
+                        proposals, outcome = call_suggester(
+                            suggester, exp, want, breaker, self.fault_injector
                         )
-                    for proposal in proposals:
-                        trial = self._materialize(exp, proposal, early_stopper, suggester)
-                        futures[pool.submit(self._execute, exp, trial, mesh)] = trial
-                    if proposals:
-                        self._persist_suggester(exp, suggester)
-                        # journal the newly in-flight trials so a crash here
-                        # leaves resubmittable orphans (and the UI sees them)
-                        self._publish(exp)
+                        if outcome == "exhausted":
+                            exhausted = True
+                        elif outcome == "error":
+                            suggester_busy = True
+                            obs.suggester_errors.inc(algorithm=spec.algorithm.name)
+                        sug_dur = time.perf_counter() - t_sug
+                        obs.suggestion_latency.observe(
+                            sug_dur, algorithm=spec.algorithm.name
+                        )
+                        # don't journal the thousands of sub-ms not-ready polls a
+                        # rung-gated suggester (Hyperband/ENAS) answers per trial
+                        if self._tracer is not None and (
+                            proposals
+                            or outcome in ("exhausted", "error")
+                            or sug_dur >= 1e-3
+                        ):
+                            self._tracer.record(
+                                "suggest",
+                                sug_start,
+                                sug_dur,
+                                algorithm=spec.algorithm.name,
+                                count=len(proposals),
+                                outcome=outcome,
+                            )
+                        for proposal in proposals:
+                            trial = self._materialize(exp, proposal, early_stopper, suggester)
+                            futures[pool.submit(self._execute, exp, trial, mesh)] = trial
+                        if proposals:
+                            self._persist_suggester(exp, suggester)
+                            # journal the newly in-flight trials so a crash here
+                            # leaves resubmittable orphans (and the UI sees them)
+                            self._publish(exp)
+
+                if breaker.tripped:
+                    # N consecutive suggester failures: terminal.  Wind down
+                    # in-flight trials, surface the last traceback.
+                    stop_event.set()
+                    self._cancel_pending(futures)
+                    self._harvest(exp, futures, wait_running=True)
+                    exp.condition = ExperimentCondition.FAILED
+                    exp.message = (
+                        f"suggester failed {breaker.failures} consecutive times "
+                        f"(suggester_max_errors={spec.suggester_max_errors}); "
+                        "last error:\n" + breaker.last_failure
+                    )
+                    exp.completion_time = time.time()
+                    exp.update_optimal()
+                    self._finish(exp)
+                    return exp
 
                 # livelock guard: nothing running, nothing proposed, not
-                # exhausted — a buggy suggester would spin here forever
-                if not futures and not proposals and not exhausted:
+                # exhausted — a buggy suggester would spin here forever.  A
+                # cooling/erroring suggester is the breaker's problem, not a
+                # stall: its own threshold terminates the experiment.
+                if not futures and not proposals and not exhausted and not suggester_busy:
                     stalled_polls += 1
                     if stalled_polls * self.poll_interval > 30.0:
                         exp.condition = ExperimentCondition.FAILED
@@ -366,6 +400,8 @@ class Orchestrator:
                 retain=exp.spec.retain,
                 max_runtime_seconds=exp.spec.max_trial_runtime_seconds,
                 metrics_retries=exp.spec.metrics_retries,
+                max_retries=exp.spec.max_retries,
+                retry_backoff_seconds=exp.spec.retry_backoff_seconds,
             ),
             condition=TrialCondition.RUNNING,
             start_time=time.time(),
@@ -446,23 +482,65 @@ class Orchestrator:
                         )
                 with self.slice_allocator.slice_mesh(**kwargs) as trial_mesh:
                     return self._execute_with_retry(exp, trial, trial_mesh)
-            except Exception:
-                return TrialResult(TrialCondition.FAILED, traceback.format_exc(limit=20))
+            except Exception as e:
+                return TrialResult(
+                    TrialCondition.FAILED,
+                    traceback.format_exc(limit=20),
+                    failure_kind=faults.classify_exception(e),
+                )
         return self._execute_with_retry(exp, trial, mesh)
 
     def _execute_with_retry(self, exp: Experiment, trial: Trial, mesh):
-        """Bounded re-run when the trial succeeded but never reported the
-        objective metric — the analog of the reference requeueing
-        metrics-not-reported trials after 1s (``trial_controller.go:182-185``).
-        Opt-in via ``metrics_retries`` (default 0: classify immediately)."""
+        """Bounded re-execution of one trial slot; both retry families share
+        the exponential-backoff helper (jittered, capped at ~30s, responsive
+        to ``stop_event`` so a requested stop is never delayed by a pending
+        retry):
+
+        - **transient failures** (``max_retries``): preemptions /
+          RESOURCE_EXHAUSTED / retryable exit codes re-run under the same
+          name and checkpoint dir so a checkpoint-aware ``train_fn`` resumes
+          mid-trial; PERMANENT failures (ValueError/assertion/shape errors)
+          classify immediately.  Each spent retry bumps ``trial.retry_count``
+          and is journaled *before* the backoff sleep, so resume-after-crash
+          continues with the budget already spent instead of resetting it.
+        - **metrics-unavailable re-runs** (``metrics_retries``): the trial
+          exited cleanly but never reported the objective — the analog of
+          the reference requeueing metrics-not-reported trials after 1s
+          (``trial_controller.go:182-185``).
+
+        The trial stays non-terminal throughout, so it consumes exactly one
+        ``max_trial_count`` slot regardless of attempts."""
+        backoff = faults.Backoff(
+            base=trial.spec.retry_backoff_seconds,
+            cap=30.0,
+            seed=f"{exp.name}:{trial.name}",
+        )
+        attempts = 1
         result = self._execute_on(exp, trial, mesh)
-        for _ in range(trial.spec.metrics_retries):
+        while (
+            result.condition is TrialCondition.FAILED
+            and result.failure_kind is faults.FailureKind.TRANSIENT
+            and trial.retry_count < trial.spec.max_retries
+            and not self._stop_event.is_set()
+        ):
+            trial.retry_count += 1
+            trial.failure_kind = faults.FailureKind.TRANSIENT.value
+            obs.trials_retried.inc(kind=faults.FailureKind.TRANSIENT.value)
+            # journal the spent retry before sleeping: a crash mid-backoff
+            # must not reset the per-trial retry budget on resume
+            self._publish(exp)
+            if not backoff.wait(trial.retry_count, self._stop_event):
+                break
+            attempts += 1
+            result = self._execute_on(exp, trial, mesh)
+        for i in range(trial.spec.metrics_retries):
             if result.condition is not TrialCondition.METRICS_UNAVAILABLE:
                 break
-            if self._stop_event.is_set():
+            if not backoff.wait(i + 1, self._stop_event):
                 break
-            time.sleep(1.0)
+            attempts += 1
             result = self._execute_on(exp, trial, mesh)
+        obs.trial_attempts.observe(float(attempts))
         return result
 
     def _execute_on(self, exp: Experiment, trial: Trial, mesh):
@@ -476,9 +554,14 @@ class Orchestrator:
                     return run_trial(
                         trial, self.store, exp.spec.objective,
                         mesh=mesh, stop_event=self._stop_event,
+                        injector=self.fault_injector,
                     )
-            except Exception:
-                return TrialResult(TrialCondition.FAILED, traceback.format_exc(limit=20))
+            except Exception as e:
+                return TrialResult(
+                    TrialCondition.FAILED,
+                    traceback.format_exc(limit=20),
+                    failure_kind=faults.classify_exception(e),
+                )
             finally:
                 self._profile_lock.release()
         return run_trial(
@@ -487,6 +570,7 @@ class Orchestrator:
             exp.spec.objective,
             mesh=mesh,
             stop_event=self._stop_event,
+            injector=self.fault_injector,
         )
 
     def _finish(self, exp: Experiment) -> None:
@@ -595,6 +679,8 @@ class Orchestrator:
             result = f.result()  # _execute never raises
             trial.condition = result.condition
             trial.message = result.message
+            fk = getattr(result, "failure_kind", None)
+            trial.failure_kind = fk.value if fk is not None else None
             trial.completion_time = time.time()
             if trial.condition in (
                 TrialCondition.SUCCEEDED,

@@ -103,6 +103,8 @@ def trial_from_dict(spec: ExperimentSpec, data: dict) -> Trial:
             retain=spec.retain,
             max_runtime_seconds=spec.max_trial_runtime_seconds,
             metrics_retries=spec.metrics_retries,
+            max_retries=spec.max_retries,
+            retry_backoff_seconds=spec.retry_backoff_seconds,
         ),
         # non-terminal journal entries become PENDING: run() resubmits them
         condition=TrialCondition.PENDING if resubmit else condition,
@@ -111,6 +113,10 @@ def trial_from_dict(spec: ExperimentSpec, data: dict) -> Trial:
         start_time=data.get("start_time") or 0.0,
         completion_time=data.get("completion_time") or 0.0,
         checkpoint_dir=data.get("checkpoint_dir"),
+        # restoring the spent retry budget is what makes the budget crash-proof:
+        # a trial that burned 2 of 3 retries before the crash gets 1 more, not 3
+        retry_count=int(data.get("retry_count") or 0),
+        failure_kind=data.get("failure_kind"),
     )
 
 

@@ -39,6 +39,10 @@ def trial_to_dict(trial: Trial) -> dict:
         "start_time": trial.start_time,
         "completion_time": trial.completion_time,
         "checkpoint_dir": trial.checkpoint_dir,
+        # fault-tolerance state: journaled so a resumed process continues
+        # the retry budget instead of resetting it (utils/faults.py taxonomy)
+        "retry_count": trial.retry_count,
+        "failure_kind": trial.failure_kind,
     }
 
 
@@ -60,6 +64,9 @@ def experiment_to_dict(exp: Experiment) -> dict:
             "early_stopped": exp.early_stopped_count,
             "metrics_unavailable": exp.metrics_unavailable_count,
             "running": exp.running_count,
+            # total transient retries spent across all trials (surfaced in
+            # the UI counter strip and `katib-tpu describe`)
+            "retried": sum(t.retry_count for t in exp.trials.values()),
         },
         # mutable algorithm settings (Hyperband bracket state lives here) —
         # persisting them is what makes the journal a full resume source

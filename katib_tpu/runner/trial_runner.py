@@ -25,6 +25,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import re
+import signal
 import subprocess
 import threading
 import time
@@ -40,12 +41,25 @@ from katib_tpu.runner.context import TrialContext, TrialEarlyStopped
 from katib_tpu.runner.metrics import parse_json_lines, parse_text_lines_fast
 from katib_tpu.store.base import ObservationStore
 from katib_tpu.utils import tracing
+from katib_tpu.utils.faults import (
+    FailureKind,
+    classify_exception,
+    classify_exit_code,
+)
 
 
 class TrialResult:
-    def __init__(self, condition: TrialCondition, message: str = ""):
+    def __init__(
+        self,
+        condition: TrialCondition,
+        message: str = "",
+        failure_kind: FailureKind | None = None,
+    ):
         self.condition = condition
         self.message = message
+        # why the attempt failed (``utils.faults`` taxonomy) — the
+        # orchestrator's retry loop re-runs TRANSIENT failures only
+        self.failure_kind = failure_kind
 
 
 def run_trial(
@@ -54,22 +68,36 @@ def run_trial(
     objective,
     mesh=None,
     stop_event: threading.Event | None = None,
+    injector=None,
 ) -> TrialResult:
     """Execute one trial to a terminal condition.  Never raises: failures
-    become ``TrialCondition.FAILED`` with the traceback in ``message``
-    (budget accounting needs failed trials recorded, not exceptions —
-    reference ``experiment_controller.go:274-330``)."""
+    become ``TrialCondition.FAILED`` with the traceback in ``message`` and
+    their ``FailureKind`` classified (budget accounting needs failed trials
+    recorded, not exceptions — reference ``experiment_controller.go:274-330``).
+
+    ``injector`` (a ``faults.FaultInjector``) is the chaos seam: it fires
+    inside this classification try-block, so injected faults take exactly
+    the path a real preemption or shape error would."""
     evaluator = RuleEvaluator(trial.spec.early_stopping_rules, objective)
     try:
+        if injector is not None:
+            injector.on_trial_attempt(trial)
+            injector.apply_metrics_delay(trial, stop_event)
         if trial.spec.train_fn is not None:
             return _run_whitebox(trial, store, evaluator, objective, mesh, stop_event)
         if trial.spec.command:
             return _run_blackbox(trial, store, evaluator, objective, stop_event)
         return TrialResult(
-            TrialCondition.FAILED, "trial has neither train_fn nor command"
+            TrialCondition.FAILED,
+            "trial has neither train_fn nor command",
+            failure_kind=FailureKind.PERMANENT,
         )
-    except Exception:
-        return TrialResult(TrialCondition.FAILED, traceback.format_exc(limit=20))
+    except Exception as e:
+        return TrialResult(
+            TrialCondition.FAILED,
+            traceback.format_exc(limit=20),
+            failure_kind=classify_exception(e),
+        )
 
 
 def _finalize(trial: Trial, store: ObservationStore, objective) -> TrialResult:
@@ -106,9 +134,12 @@ def _run_whitebox(
     )
 
     def _deadline_result() -> TrialResult:
+        # a deadline blown once will blow again on an identical re-run —
+        # never worth a transient retry
         return TrialResult(
             TrialCondition.FAILED,
             f"trial exceeded max_runtime_seconds={trial.spec.max_runtime_seconds}",
+            failure_kind=FailureKind.PERMANENT,
         )
 
     try:
@@ -120,8 +151,12 @@ def _run_whitebox(
         if ctx.deadline_exceeded():
             return _deadline_result()
         return TrialResult(TrialCondition.KILLED, str(e))
-    except Exception:
-        return TrialResult(TrialCondition.FAILED, traceback.format_exc(limit=20))
+    except Exception as e:
+        return TrialResult(
+            TrialCondition.FAILED,
+            traceback.format_exc(limit=20),
+            failure_kind=classify_exception(e),
+        )
     if evaluator.should_stop():
         return TrialResult(TrialCondition.EARLY_STOPPED, evaluator.triggered.describe())
     if ctx.deadline_exceeded():
@@ -375,6 +410,10 @@ def _run_blackbox(
         return parse_text_lines_fast(lines, metric_names, filters)
 
     try:
+        # start_new_session puts the trial in its own process group/session:
+        # terminate/kill below signal the WHOLE group, so a trainer that
+        # forks workers (data loaders, launchers) can't leave grandchildren
+        # holding TPU devices after the trial is reaped
         proc = subprocess.Popen(
             argv,
             stdout=subprocess.PIPE,
@@ -382,9 +421,14 @@ def _run_blackbox(
             text=True,
             errors="replace",
             bufsize=1,
+            start_new_session=(os.name == "posix"),
         )
     except OSError as e:
-        return TrialResult(TrialCondition.FAILED, f"failed to launch {argv[0]}: {e}")
+        return TrialResult(
+            TrialCondition.FAILED,
+            f"failed to launch {argv[0]}: {e}",
+            failure_kind=classify_exception(e),
+        )
     launched_at = time.perf_counter()
 
     # metrics come from exactly one source: the file when configured, else
@@ -423,10 +467,11 @@ def _run_blackbox(
             # hung trial instead of pinning an orchestrator slot forever
             deadline_hit = True
         if (early_stopped or killed or deadline_hit) and terminate_at is None:
-            proc.terminate()
+            _signal_group(proc, signal.SIGTERM)
             terminate_at = time.monotonic()
         if terminate_at is not None and time.monotonic() - terminate_at > 10.0:
-            proc.kill()  # SIGTERM ignored; escalate (classification unchanged)
+            # SIGTERM ignored; escalate (classification unchanged)
+            _signal_group(proc, signal.SIGKILL)
             terminate_at = float("inf")
         if proc.poll() is not None:
             break
@@ -462,5 +507,28 @@ def _run_blackbox(
     if killed:
         return TrialResult(TrialCondition.KILLED, "experiment reached terminal state")
     if rc != 0:
-        return TrialResult(TrialCondition.FAILED, f"exit code {rc}")
+        return TrialResult(
+            TrialCondition.FAILED,
+            f"exit code {rc}",
+            failure_kind=classify_exit_code(rc),
+        )
     return _finalize(trial, store, objective)
+
+
+def _signal_group(proc: subprocess.Popen, sig: int) -> None:
+    """Signal the trial's whole process group (the child is its own session
+    leader, so ``pid == pgid``); fall back to the child alone when the group
+    is already gone or group signalling is unsupported."""
+    if os.name == "posix":
+        try:
+            os.killpg(proc.pid, sig)
+            return
+        except (ProcessLookupError, PermissionError, OSError):
+            pass
+    try:
+        if sig == getattr(signal, "SIGKILL", None):
+            proc.kill()
+        else:
+            proc.terminate()
+    except OSError:
+        pass

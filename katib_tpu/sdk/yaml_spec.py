@@ -340,6 +340,9 @@ def experiment_spec_from_dict(data: Mapping[str, Any]) -> ExperimentSpec:
             else None
         ),
         metrics_retries=int(spec.get("metricsRetries", 0)),
+        max_retries=int(spec.get("maxRetries", 0)),
+        retry_backoff_seconds=float(spec.get("retryBackoffSeconds", 1.0)),
+        suggester_max_errors=int(spec.get("suggesterMaxErrors", 5)),
     )
 
 

@@ -69,6 +69,54 @@ class SearchExhausted(RuntimeError):
     (``hyperband/service.py:47-49``)."""
 
 
+#: Exceptions that are suggester *control flow*, not faults — the
+#: orchestrator's circuit breaker must never count them as failures.
+CONTROL_FLOW_EXCEPTIONS = (SearchExhausted, SuggestionsNotReady)
+
+
+def call_suggester(
+    suggester: "Suggester",
+    experiment: Experiment,
+    count: int,
+    breaker=None,
+    injector=None,
+) -> tuple[list[TrialAssignmentSet], str]:
+    """One fault-isolated ``get_suggestions`` call — the single seam through
+    which the orchestrator talks to an algorithm.
+
+    Returns ``(proposals, outcome)`` with outcome one of ``"ok"``,
+    ``"exhausted"``, ``"not_ready"``, ``"error"``.  Control-flow signals
+    (:data:`CONTROL_FLOW_EXCEPTIONS`) close the ``breaker`` — they prove the
+    suggester is healthy — while any other exception is recorded as a failure
+    with its traceback (the reference retries suggestion-service RPC errors
+    at the controller, ``suggestionclient.go:57-60``; here the breaker bounds
+    those retries).  The caller checks ``breaker.tripped`` for the terminal
+    verdict and ``breaker.allow()`` before calling again.  ``injector`` is
+    the ``faults.FaultInjector`` chaos seam.
+    """
+    import traceback as _traceback
+
+    try:
+        if injector is not None:
+            injector.on_suggester_call()
+        proposals = suggester.get_suggestions(experiment, count)
+    except SearchExhausted:
+        if breaker is not None:
+            breaker.record_success()
+        return [], "exhausted"
+    except SuggestionsNotReady:
+        if breaker is not None:
+            breaker.record_success()
+        return [], "not_ready"
+    except Exception:
+        if breaker is not None:
+            breaker.record_failure(_traceback.format_exc(limit=20))
+        return [], "error"
+    if breaker is not None:
+        breaker.record_success()
+    return proposals, "ok"
+
+
 class Suggester(abc.ABC):
     """One suggestion algorithm bound to one experiment."""
 

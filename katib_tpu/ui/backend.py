@@ -605,8 +605,10 @@ async function counters(){
     `<small>trials: ${tot('katib_trial_created_total')} created · `+
     `${tot('katib_trial_succeeded_total')} succeeded · `+
     `${tot('katib_trial_failed_total')} failed · `+
+    `${tot('katib_trial_retried_total')} retried · `+
     `${tot('katib_trial_early_stopped_total')} early-stopped · `+
     `experiments running: ${tot('katib_experiments_current')}`+
+    (tot('katib_suggester_errors_total')?` · suggester errors: ${tot('katib_suggester_errors_total')}`:'')+
     (mean!==null?` · mean trial ${mean.toFixed(1)}s`:'')+'</small>';
 }
 async function refresh(){

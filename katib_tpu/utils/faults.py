@@ -1,0 +1,423 @@
+"""Failure classification, retry backoff, circuit breaking, and deterministic
+fault injection — the fault-tolerance vocabulary shared by the orchestrator
+and the trial runner.
+
+The reference treats trial failure as a controller-level concern: the trial
+controller requeues metrics-less trials (``trial_controller.go:182-185``) and
+the experiment controller counts failures against ``maxFailedTrialCount``
+(``experiment_controller.go:274-330``), but a pod OOM-kill and a shape bug
+both land in the same ``Failed`` bucket.  On TPUs that conflation is
+expensive: preemptions and ``RESOURCE_EXHAUSTED`` are *normal* events on
+long sweeps (Podracer-style architectures treat worker preemption as
+routine), while a ``ValueError`` from a bad hyperparameter will fail
+identically on every re-run.  This module draws that line once:
+
+- :class:`FailureKind` + the ``classify_*`` helpers decide TRANSIENT
+  (retry-worthy: preemption, RESOURCE_EXHAUSTED, OSError family, a
+  signal-killed subprocess) vs PERMANENT (deterministic: ValueError /
+  assertion / shape errors, ordinary nonzero exits);
+- :class:`Backoff` is the one exponential-backoff-with-jitter helper
+  (capped, stop-event responsive) used for trial retries, metrics re-runs,
+  and suggester cooldowns;
+- :class:`CircuitBreaker` isolates a flaky suggester: closed → cooling →
+  half-open probe per failure, tripped open (terminal) after ``threshold``
+  consecutive failures;
+- :class:`FaultInjector` is the seeded, spec-driven chaos harness threaded
+  through the orchestrator/runner seams ("fail trial k's attempt j as
+  transient", "raise in suggester call n", "corrupt checkpoint step s",
+  "delay metrics by d") so every recovery path is exercised
+  deterministically in tests and via ``katib-tpu chaos``.
+
+Everything here is stdlib-only (jax-free) so classification is importable
+from metadata-only paths (status serialization, the CLI).
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+import random
+import threading
+import time
+
+
+class FailureKind(str, enum.Enum):
+    """Why a trial attempt failed — the retry decision in one bit.
+
+    Values are the journal/metric-label strings (``status.json``
+    ``failure_kind``, ``katib_trial_retried_total{kind=...}``).
+    """
+
+    TRANSIENT = "Transient"
+    PERMANENT = "Permanent"
+
+
+# Infrastructure-failure markers inside exception text / tracebacks.  TPU
+# preemptions and allocator exhaustion surface as XlaRuntimeError (a
+# RuntimeError) whose *message* carries the gRPC-style status — there is no
+# stable exception type to catch across jaxlib versions, so match the text.
+_TRANSIENT_MARKERS = (
+    "resource_exhausted",
+    "resource exhausted",
+    "out of memory",
+    "unavailable",
+    "deadline_exceeded",
+    "preempt",  # "preempted", "preemption notice received"
+    "connection reset",
+    "broken pipe",
+    "temporarily",  # EAGAIN-style "resource temporarily unavailable"
+    "device or resource busy",
+    "injected transient",  # FaultInjector tracebacks classify like the real thing
+)
+
+# Exception families with an unambiguous kind.  Checked before the text
+# markers: a ValueError whose message happens to say "unavailable" is still
+# a deterministic bug.
+_TRANSIENT_TYPES = (
+    MemoryError,
+    ConnectionError,
+    TimeoutError,
+    InterruptedError,
+    OSError,  # the taxonomy's catch-all for host/IO flakiness
+)
+_PERMANENT_TYPES = (
+    ValueError,  # shape errors, bad hyperparameters, failed casts
+    TypeError,
+    AssertionError,
+    KeyError,
+    IndexError,
+    AttributeError,
+    ZeroDivisionError,
+    NotImplementedError,
+)
+
+# Exit codes worth a re-run: a signal-killed subprocess (Popen reports
+# negative returncodes; shells report 128+signum) usually means the host OOM
+# killer or a preemption SIGTERM, and EX_TEMPFAIL (75) is the sysexits
+# convention for "try again".  SIGABRT (134) is included because libtpu
+# aborts the process on slice/device health events.
+RETRYABLE_EXIT_CODES = frozenset({75, 128 + 6, 128 + 9, 128 + 15})
+
+
+def _classify_text(text: str) -> FailureKind:
+    low = text.lower()
+    if any(marker in low for marker in _TRANSIENT_MARKERS):
+        return FailureKind.TRANSIENT
+    return FailureKind.PERMANENT
+
+
+def classify_exception(exc: BaseException) -> FailureKind:
+    """Classify a caught exception.  Unknown types default to PERMANENT —
+    retrying a bug wastes the retry budget, while a missed transient only
+    costs one trial slot."""
+    if isinstance(exc, InjectedFault):
+        return exc.kind
+    if isinstance(exc, _TRANSIENT_TYPES):
+        return FailureKind.TRANSIENT
+    if isinstance(exc, _PERMANENT_TYPES):
+        return FailureKind.PERMANENT
+    return _classify_text(f"{type(exc).__name__}: {exc}")
+
+
+def classify_traceback(text: str) -> FailureKind:
+    """Classify from traceback *text* — the whitebox path journals only the
+    formatted traceback, and resumed trials have no live exception object."""
+    low = text.lower()
+    if any(marker in low for marker in _TRANSIENT_MARKERS):
+        return FailureKind.TRANSIENT
+    for name in (
+        "oserror",
+        "connectionerror",
+        "connectionreseterror",
+        "brokenpipeerror",
+        "timeouterror",
+        "memoryerror",
+        "interruptederror",
+        "filenotfounderror",
+        "permissionerror",
+    ):
+        # the raising line is "SomeError: message"; a colon keeps substring
+        # matches from firing on prose that merely mentions the type
+        if f"{name}:" in low or low.rstrip().endswith(name):
+            return FailureKind.TRANSIENT
+    return FailureKind.PERMANENT
+
+
+def classify_exit_code(rc: int) -> FailureKind:
+    """Classify a black-box subprocess exit.  Negative = killed by signal
+    (OOM killer, preemption SIGTERM) → transient; the ``RETRYABLE_EXIT_CODES``
+    set covers the shell-style 128+signum encodings and EX_TEMPFAIL; any
+    other nonzero exit is the trial's own deterministic failure."""
+    if rc < 0 or rc in RETRYABLE_EXIT_CODES:
+        return FailureKind.TRANSIENT
+    return FailureKind.PERMANENT
+
+
+# ---------------------------------------------------------------------------
+# Backoff
+# ---------------------------------------------------------------------------
+
+
+class Backoff:
+    """Exponential backoff with deterministic jitter, capped at ``cap``.
+
+    ``delay(attempt)`` for 1-based attempts is ``base * factor**(attempt-1)``
+    clamped to ``cap``, then scaled by a ±``jitter`` fraction drawn from a
+    seeded RNG (same seed → same schedule, so chaos runs reproduce).
+    ``wait`` sleeps through ``stop_event.wait`` so a requested experiment
+    stop is never delayed by a pending retry.
+    """
+
+    def __init__(
+        self,
+        base: float = 1.0,
+        factor: float = 2.0,
+        cap: float = 30.0,
+        jitter: float = 0.25,
+        seed=None,
+    ):
+        self.base = max(0.0, float(base))
+        self.factor = float(factor)
+        self.cap = float(cap)
+        self.jitter = float(jitter)
+        self._rng = random.Random(seed)
+
+    def delay(self, attempt: int) -> float:
+        d = min(self.base * self.factor ** max(0, attempt - 1), self.cap)
+        if self.jitter:
+            d *= 1.0 + self.jitter * (2.0 * self._rng.random() - 1.0)
+        return max(0.0, min(d, self.cap))
+
+    def wait(self, attempt: int, stop_event: threading.Event | None = None) -> bool:
+        """Sleep out the attempt's delay.  Returns False when interrupted by
+        ``stop_event`` (the caller should abandon the retry)."""
+        d = self.delay(attempt)
+        if stop_event is None:
+            time.sleep(d)
+            return True
+        return not stop_event.wait(d)
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker
+# ---------------------------------------------------------------------------
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker for the suggester seam.
+
+    States (``state`` property):
+
+    - ``closed``  — healthy; calls allowed.
+    - ``cooling`` — a failure was recorded; ``allow()`` is False until the
+      exponential cooldown elapses (bounded retry-with-backoff).
+    - ``half-open`` — cooldown elapsed; exactly the next call is the probe.
+      Success closes the breaker, failure re-enters cooling.
+    - ``open``    — ``threshold`` consecutive failures (``tripped``); the
+      caller fails the experiment with ``last_failure``.
+
+    Not thread-safe by design: it lives on the orchestrator's single event
+    loop.  ``clock`` is injectable for deterministic tests.
+    """
+
+    def __init__(
+        self,
+        threshold: int = 5,
+        base_cooldown: float = 0.05,
+        cap: float = 30.0,
+        clock=time.monotonic,
+    ):
+        self.threshold = max(1, int(threshold))
+        self.base_cooldown = float(base_cooldown)
+        self.cap = float(cap)
+        self._clock = clock
+        self.failures = 0
+        self.last_failure = ""
+        self._retry_at = 0.0
+
+    @property
+    def tripped(self) -> bool:
+        return self.failures >= self.threshold
+
+    @property
+    def state(self) -> str:
+        if self.tripped:
+            return "open"
+        if self.failures == 0:
+            return "closed"
+        return "half-open" if self._clock() >= self._retry_at else "cooling"
+
+    def allow(self) -> bool:
+        """May the caller attempt a call right now?"""
+        return not self.tripped and self._clock() >= self._retry_at
+
+    def record_failure(self, detail: str = "") -> bool:
+        """Count one failure; returns True when this one trips the breaker."""
+        self.failures += 1
+        self.last_failure = detail
+        self._retry_at = self._clock() + min(
+            self.base_cooldown * 2.0 ** (self.failures - 1), self.cap
+        )
+        return self.tripped
+
+    def record_success(self) -> None:
+        self.failures = 0
+        self.last_failure = ""
+        self._retry_at = 0.0
+
+
+# ---------------------------------------------------------------------------
+# Deterministic fault injection
+# ---------------------------------------------------------------------------
+
+
+class InjectedFault(RuntimeError):
+    """A failure planted by :class:`FaultInjector`; carries its kind so
+    ``classify_exception`` routes it exactly like the real thing."""
+
+    def __init__(self, message: str, kind: FailureKind = FailureKind.TRANSIENT):
+        super().__init__(message)
+        self.kind = kind
+
+
+class FaultInjector:
+    """Seeded, spec-driven chaos harness.
+
+    Spec builders (chainable) address trials by *creation index* (0-based,
+    deterministic under ``parallel_trial_count=1``) or by name; attempts are
+    1-based and count every execution of the trial body (transient retries
+    and metrics re-runs alike):
+
+    - ``fail_trial(k, j, kind)`` — raise at the start of trial k's attempt j;
+    - ``fail_suggester(n)``      — raise inside the n-th (1-based)
+      ``get_suggestions`` call;
+    - ``corrupt_checkpoint(k, step)`` — overwrite the files of checkpoint
+      ``step`` before trial k's next attempt (fires once);
+    - ``delay_metrics(k, d)``    — stall trial k's metric production by d
+      seconds each attempt (stop-event responsive);
+    - ``flake(rate, kind)``      — seeded random per-attempt failures.
+
+    The seams (``on_trial_attempt`` / ``on_suggester_call`` /
+    ``apply_metrics_delay``) are called by the runner/orchestrator inside
+    their normal classification paths, so an injected fault takes exactly
+    the code path a real one would.  ``log`` records every injection that
+    fired, for assertions and the ``katib-tpu chaos`` report.
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._trial_faults: dict[tuple[object, int], FailureKind] = {}
+        self._suggester_calls: set[int] = set()
+        self._corruptions: dict[object, list[int]] = {}
+        self._metric_delays: dict[object, float] = {}
+        self._flake_rate = 0.0
+        self._flake_kind = FailureKind.TRANSIENT
+        self._order: dict[str, int] = {}  # trial name -> creation index
+        self._attempts: dict[str, int] = {}  # trial name -> attempts so far
+        self._suggester_count = 0
+        self.log: list[dict] = []
+
+    # -- spec builders ------------------------------------------------------
+
+    def fail_trial(self, trial, attempt: int, kind=FailureKind.TRANSIENT):
+        self._trial_faults[(trial, int(attempt))] = FailureKind(kind)
+        return self
+
+    def fail_suggester(self, call: int):
+        self._suggester_calls.add(int(call))
+        return self
+
+    def corrupt_checkpoint(self, trial, step: int):
+        self._corruptions.setdefault(trial, []).append(int(step))
+        return self
+
+    def delay_metrics(self, trial, seconds: float):
+        self._metric_delays[trial] = float(seconds)
+        return self
+
+    def flake(self, rate: float, kind=FailureKind.TRANSIENT):
+        self._flake_rate = float(rate)
+        self._flake_kind = FailureKind(kind)
+        return self
+
+    # -- seams --------------------------------------------------------------
+
+    def attempts_of(self, trial_name: str) -> int:
+        with self._lock:
+            return self._attempts.get(trial_name, 0)
+
+    def _keys(self, name: str, idx: int):
+        return (name, idx)
+
+    def on_trial_attempt(self, trial) -> None:
+        """Runner seam, called at the start of every attempt inside the
+        classification try-block.  May corrupt checkpoints or raise."""
+        name = trial.name
+        with self._lock:
+            idx = self._order.setdefault(name, len(self._order))
+            attempt = self._attempts[name] = self._attempts.get(name, 0) + 1
+            corrupt_steps = []
+            for key in self._keys(name, idx):
+                corrupt_steps += self._corruptions.pop(key, [])
+            kind = None
+            for key in self._keys(name, idx):
+                if (key, attempt) in self._trial_faults:
+                    kind = self._trial_faults[(key, attempt)]
+                    break
+            if kind is None and self._flake_rate and self._rng.random() < self._flake_rate:
+                kind = self._flake_kind
+        for step in corrupt_steps:
+            self._corrupt_step(trial.checkpoint_dir, step, name)
+        if kind is not None:
+            self.log.append(
+                {"seam": "trial", "trial": name, "attempt": attempt, "kind": kind.value}
+            )
+            raise InjectedFault(
+                f"injected {kind.value.lower()} fault: trial={name} attempt={attempt}",
+                kind,
+            )
+
+    def on_suggester_call(self) -> None:
+        """Orchestrator seam, called inside the fault-isolated
+        ``get_suggestions`` wrapper."""
+        with self._lock:
+            self._suggester_count += 1
+            n = self._suggester_count
+        if n in self._suggester_calls:
+            self.log.append({"seam": "suggester", "call": n})
+            raise InjectedFault(f"injected suggester fault: call={n}")
+
+    def apply_metrics_delay(self, trial, stop_event: threading.Event | None = None) -> None:
+        """Runner seam: stall the trial's metric production (exercises
+        deadline / metrics-retry interplay)."""
+        with self._lock:
+            idx = self._order.get(trial.name)
+        delay = 0.0
+        for key in (trial.name, idx):
+            if key is not None and key in self._metric_delays:
+                delay = self._metric_delays[key]
+                break
+        if delay <= 0.0:
+            return
+        self.log.append({"seam": "metrics", "trial": trial.name, "delay": delay})
+        if stop_event is not None:
+            stop_event.wait(delay)
+        else:
+            time.sleep(delay)
+
+    def _corrupt_step(self, checkpoint_dir: str | None, step: int, name: str) -> None:
+        if not checkpoint_dir:
+            return
+        step_dir = os.path.join(checkpoint_dir, str(step))
+        if not os.path.isdir(step_dir):
+            return
+        self.log.append({"seam": "checkpoint", "trial": name, "step": step})
+        for root, _, files in os.walk(step_dir):
+            for fname in files:
+                try:
+                    with open(os.path.join(root, fname), "wb") as f:
+                        f.write(b"\x00CORRUPTED-BY-FAULT-INJECTOR")
+                except OSError:
+                    pass

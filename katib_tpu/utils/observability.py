@@ -360,6 +360,14 @@ trials_metrics_unavailable = REGISTRY.counter(
     "katib_trial_metrics_unavailable_total",
     "Trials finishing without reporting the objective metric",
 )
+trials_retried = REGISTRY.counter(
+    "katib_trial_retried_total",
+    "Trial attempts re-run after a classified failure (kind label)",
+)
+suggester_errors = REGISTRY.counter(
+    "katib_suggester_errors_total",
+    "get_suggestions exceptions absorbed by the circuit breaker (algorithm label)",
+)
 
 # -- latency distributions + device telemetry ---------------------------------
 
@@ -374,6 +382,12 @@ trial_duration = REGISTRY.histogram(
 suggestion_latency = REGISTRY.histogram(
     "katib_suggestion_latency_seconds",
     "Latency of suggester get_suggestions calls",
+)
+trial_attempts = REGISTRY.histogram(
+    "katib_trial_attempts",
+    "Executions per terminal trial (1 = no retry; includes transient retries "
+    "and metrics re-runs)",
+    buckets=(1.0, 2.0, 3.0, 4.0, 5.0, 8.0, 13.0),
 )
 trial_step_seconds = REGISTRY.histogram(
     "katib_trial_step_seconds",

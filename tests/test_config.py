@@ -180,10 +180,11 @@ class TestOrchestratorWiring:
 
 class TestReviewRegressions:
     def test_suggester_crash_balances_gauge_and_fails_status(self, tmp_path):
-        """An unexpected suggester exception must wind down cleanly: gauge
-        balanced, status journal shows Failed, and the bug surfaces."""
-        import pytest as _pytest
-
+        """A persistently crashing suggester must wind down cleanly: the
+        circuit breaker absorbs ``suggester_max_errors - 1`` exceptions,
+        then the experiment fails (no raise) with the bug's traceback in
+        its message, the gauge balanced, and the journal showing Failed."""
+        from katib_tpu.core.types import ExperimentCondition
         from katib_tpu.orchestrator.orchestrator import Orchestrator
         from katib_tpu.orchestrator.status import read_status
         from katib_tpu.suggest import base as suggest_base
@@ -196,7 +197,7 @@ class TestReviewRegressions:
             def get_suggestions(self, exp, n):
                 raise Boom("bug")
 
-        spec = make_spec("random", max_trial_count=4)
+        spec = make_spec("random", max_trial_count=4, suggester_max_errors=2)
         orig = suggest_base.make_suggester
         suggest_base.make_suggester = lambda s: BoomSuggester()
         # the orchestrator imports the symbol directly; patch there too
@@ -206,15 +207,16 @@ class TestReviewRegressions:
         orch_mod.make_suggester = lambda s: BoomSuggester()
         try:
             orch = Orchestrator(workdir=str(tmp_path))
-            with _pytest.raises(Boom):
-                orch.run(spec)
+            exp = orch.run(spec)
         finally:
             suggest_base.make_suggester = orig
             orch_mod.make_suggester = orch_orig
+        assert exp.condition is ExperimentCondition.FAILED
+        assert "Boom" in exp.message  # the bug's traceback surfaces
         assert obs.experiments_current.get() == 0
         status = read_status(str(tmp_path), spec.name)
         assert status["condition"] == "Failed"
-        assert "orchestrator error" in status["message"]
+        assert "suggester failed 2 consecutive times" in status["message"]
 
     def test_per_algorithm_mesh_resolution(self):
         from katib_tpu.orchestrator.orchestrator import Orchestrator

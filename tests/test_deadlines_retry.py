@@ -6,10 +6,12 @@
 from __future__ import annotations
 
 import sys
+import threading
 import time
 
 from katib_tpu.core.types import (
     AlgorithmSpec,
+    ExperimentCondition,
     ExperimentSpec,
     FeasibleSpace,
     MetricsCollectorKind,
@@ -164,6 +166,42 @@ class TestMetricsRetry:
         assert attempts["n"] == 3  # initial + 2 retries
 
 
+class TestRetryStopResponsiveness:
+    def test_stop_interrupts_retry_backoff(self, tmp_path):
+        """A stop() issued while a transient retry is sleeping out its
+        backoff (30s here) must return promptly — the backoff waits on the
+        stop event instead of a blind sleep."""
+
+        def boom(ctx):
+            raise OSError("preempted")
+
+        spec = ExperimentSpec(
+            name="stop-backoff",
+            algorithm=AlgorithmSpec(name="random"),
+            objective=OBJECTIVE,
+            parameters=[
+                ParameterSpec("lr", ParameterType.DOUBLE, FeasibleSpace(min=0.0, max=1.0))
+            ],
+            max_trial_count=1,
+            parallel_trial_count=1,
+            max_retries=3,
+            retry_backoff_seconds=30.0,
+            train_fn=boom,
+        )
+        orch = Orchestrator(workdir=str(tmp_path))
+        timer = threading.Timer(0.5, orch.stop)
+        timer.start()
+        try:
+            t0 = time.monotonic()
+            exp = orch.run(spec)
+            assert time.monotonic() - t0 < 10.0
+        finally:
+            timer.cancel()
+        assert exp.condition is ExperimentCondition.FAILED
+        trial = next(iter(exp.trials.values()))
+        assert trial.retry_count >= 1  # it was mid-backoff when stopped
+
+
 class TestYamlFields:
     def test_yaml_round_trip(self, tmp_path):
         from katib_tpu.sdk.yaml_spec import experiment_spec_from_dict
@@ -192,3 +230,60 @@ class TestYamlFields:
         )
         assert spec.max_trial_runtime_seconds == 120.0
         assert spec.metrics_retries == 3
+
+    def test_fault_tolerance_fields_round_trip(self):
+        from katib_tpu.sdk.yaml_spec import experiment_spec_from_dict
+
+        spec = experiment_spec_from_dict(
+            {
+                "metadata": {"name": "f"},
+                "spec": {
+                    "objective": {
+                        "type": "maximize",
+                        "objectiveMetricName": "acc",
+                    },
+                    "algorithm": {"algorithmName": "random"},
+                    "parameters": [
+                        {
+                            "name": "lr",
+                            "parameterType": "double",
+                            "feasibleSpace": {"min": "0.1", "max": "0.2"},
+                        }
+                    ],
+                    "maxRetries": 2,
+                    "retryBackoffSeconds": 0.5,
+                    "suggesterMaxErrors": 7,
+                    "trialTemplate": {"command": ["true"]},
+                },
+            }
+        )
+        assert spec.max_retries == 2
+        assert spec.retry_backoff_seconds == 0.5
+        assert spec.suggester_max_errors == 7
+
+    def test_fault_tolerance_defaults(self):
+        from katib_tpu.sdk.yaml_spec import experiment_spec_from_dict
+
+        spec = experiment_spec_from_dict(
+            {
+                "metadata": {"name": "d"},
+                "spec": {
+                    "objective": {
+                        "type": "maximize",
+                        "objectiveMetricName": "acc",
+                    },
+                    "algorithm": {"algorithmName": "random"},
+                    "parameters": [
+                        {
+                            "name": "lr",
+                            "parameterType": "double",
+                            "feasibleSpace": {"min": "0.1", "max": "0.2"},
+                        }
+                    ],
+                    "trialTemplate": {"command": ["true"]},
+                },
+            }
+        )
+        assert spec.max_retries == 0  # opt-in: no silent re-runs
+        assert spec.retry_backoff_seconds == 1.0
+        assert spec.suggester_max_errors == 5
