@@ -46,6 +46,14 @@ Env knobs:
   KATIB_REMOTE_COMPILE=1  compile on the terminal server instead of the
                           default local AOT compile (see below; same knob
                           as the scripts/ harnesses)
+  BENCH_COHORT_K          --cohort mode: members per cohort (default 8)
+  BENCH_COHORT_STEPS      --cohort mode: timed steps (default 200, small: 50)
+
+``python bench.py --cohort`` runs a separate measurement: serial vs
+vmap-batched cohort trial throughput (``runner/cohort.py``) on a tiny
+model where dispatch overhead dominates — the regime the cohort engine
+optimizes.  Emits its own JSON line (serial/cohort trials-per-sec,
+speedup) instead of the DARTS row.
 
 Compile locality: the axon relay's terminal-side compile
 (``PALLAS_AXON_REMOTE_COMPILE=1``, the ambient default) ships the HLO to
@@ -614,6 +622,151 @@ def _child() -> None:
     )
 
 
+def _cohort_child() -> None:
+    """Measure serial vs vmap-cohort trial throughput (runner/cohort.py's
+    optimization) in the regime it targets: per-step jitted dispatch of a
+    tiny model, where Python/runtime dispatch — not FLOPs — bounds a sweep.
+    K serial trials pay K×steps dispatches; one cohort pays steps dispatches
+    of a [K]-batched program.  Prints one tagged JSON line with
+    serial/cohort trials-per-sec and the speedup."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from katib_tpu.parallel.train import (
+        TrainState,
+        make_cohort_train_step,
+        make_train_step,
+        stack_pytrees,
+    )
+
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        jax.config.update("jax_platforms", want)
+    platform = jax.devices()[0].platform
+
+    k = int(os.environ.get("BENCH_COHORT_K", "8"))
+    steps = int(os.environ.get("BENCH_COHORT_STEPS", "50" if _SMALL else "200"))
+    dim, nbatch = 32, 256
+
+    key = jax.random.PRNGKey(0)
+    kx, kw = jax.random.split(key)
+    x = jax.random.normal(kx, (nbatch, dim), jnp.float32)
+    y = jnp.sum(x, axis=1, keepdims=True)
+    batch = (x, y)
+
+    def loss_fn(params, b):
+        xb, yb = b
+        return jnp.mean((xb @ params["w"] + params["b"] - yb) ** 2)
+
+    # same inject_hyperparams seam the mnist sweep uses: lr is a runtime
+    # operand, so serial AND cohort each compile exactly one program
+    tx = optax.inject_hyperparams(optax.sgd)(learning_rate=0.0)
+    params = {
+        "w": jax.random.normal(kw, (dim, 1), jnp.float32) * 0.01,
+        "b": jnp.zeros((1,), jnp.float32),
+    }
+    lrs = [0.001 * (i + 1) for i in range(k)]
+
+    def member_state(lr):
+        # fresh buffers per member: the step donates its state input, and a
+        # donated buffer shared with `params` would poison later members
+        p = jax.tree_util.tree_map(jnp.array, params)
+        s = TrainState.create(p, tx)
+        hp = dict(s.opt_state.hyperparams)
+        hp["learning_rate"] = jnp.asarray(lr, jnp.float32)
+        return s._replace(opt_state=s.opt_state._replace(hyperparams=hp))
+
+    def cohort_state():
+        s = stack_pytrees([TrainState.create(params, tx)] * k)
+        hp = dict(s.opt_state.hyperparams)
+        hp["learning_rate"] = jnp.asarray(lrs, jnp.float32)
+        return s._replace(opt_state=s.opt_state._replace(hyperparams=hp))
+
+    serial_step = make_train_step(loss_fn, tx)
+    cohort_step = make_cohort_train_step(loss_fn, tx)
+
+    # warm both traces outside the clocks (steps donate their state input)
+    s = member_state(0.01)
+    for _ in range(3):
+        s, _m = serial_step(s, batch)
+    jax.block_until_ready(s)
+    c = cohort_state()
+    for _ in range(3):
+        c, _m = cohort_step(c, batch)
+    jax.block_until_ready(c)
+
+    t0 = time.perf_counter()
+    finals = []
+    for lr in lrs:
+        s = member_state(lr)
+        for _ in range(steps):
+            s, _m = serial_step(s, batch)
+        finals.append(s)
+    jax.block_until_ready(finals)
+    t_serial = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    c = cohort_state()
+    for _ in range(steps):
+        c, _m = cohort_step(c, batch)
+    jax.block_until_ready(c)
+    t_cohort = time.perf_counter() - t0
+
+    serial_tps = k / t_serial
+    cohort_tps = k / t_cohort
+    print(
+        _RESULT_TAG
+        + json.dumps(
+            {
+                "metric": "cohort_vmap_trial_throughput",
+                "serial_trials_per_sec": round(serial_tps, 3),
+                "cohort_trials_per_sec": round(cohort_tps, 3),
+                "speedup": round(cohort_tps / serial_tps, 2),
+                "k": k,
+                "steps": steps,
+                "platform": platform,
+            }
+        )
+    )
+
+
+def _run_cohort() -> None:
+    """Parent side of ``--cohort``: run the measurement in a child (scrubbed
+    env, CPU by default — this is a dispatch-overhead benchmark, not a chip
+    benchmark) and print its JSON line."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the relay
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--cohort-child"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env=env,
+    )
+    try:
+        out, err = proc.communicate(timeout=900)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        print("bench: cohort child timed out", file=sys.stderr)
+        sys.exit(3)
+    for line in (out or "").splitlines():
+        if line.startswith(_RESULT_TAG):
+            try:
+                result = json.loads(line[len(_RESULT_TAG):])
+            except json.JSONDecodeError:
+                continue
+            print(json.dumps(result))
+            return
+    print(
+        f"bench: cohort child failed rc={proc.returncode}:\n" + (err or "")[-2000:],
+        file=sys.stderr,
+    )
+    sys.exit(3)
+
+
 def _run_attempt(
     deadline: float, env: dict | None = None
 ) -> tuple[int, dict | None, str]:
@@ -663,6 +816,12 @@ def main() -> None:
         return
     if "--aot-child" in sys.argv:
         _aot_child()
+        return
+    if "--cohort-child" in sys.argv:
+        _cohort_child()
+        return
+    if "--cohort" in sys.argv:
+        _run_cohort()
         return
 
     retries = int(os.environ.get("BENCH_RETRIES", "3"))

@@ -65,6 +65,12 @@ def cmd_run(args: argparse.Namespace) -> int:
             file=sys.stderr,
         )
         return 2
+    # persistent XLA compile cache, process-global: initialize before any
+    # jit so the first trial's trace can hit a prior run's executables
+    # (KATIB_COMPILE_CACHE env wins over the spec's compileCache field)
+    from katib_tpu.runner.trial_runner import init_compile_cache
+
+    init_compile_cache(spec.compile_cache)
     orch = cfg.make_orchestrator()
     if args.resume:
         existing = orch.load_experiment(spec)

@@ -647,6 +647,21 @@ class ExperimentSpec:
     # counted (katib_suggester_errors_total) and retried after a cooldown
     # while in-flight trials keep running.
     suggester_max_errors: int = 5
+    # Vectorized trial cohorts: up to this many compatible pending trials
+    # (same cohortKey — same model, shapes, step count) execute as ONE
+    # vmapped jitted program sharing a single compiled executable
+    # (runner/cohort.py).  1 = disabled; requires a cohort-capable train_fn
+    # (see runner.cohort.attach_cohort_fn).
+    cohort_width: int = 1
+    # Default cohort key stamped on every trial when cohort_width > 1;
+    # proposals may override per trial via the COHORT_KEY_LABEL label
+    # (PBT generations, Hyperband rungs).  None = only labeled proposals
+    # group into cohorts.
+    cohort_key: str | None = None
+    # Persistent XLA compilation-cache directory wired at run() start
+    # (jax_compilation_cache_dir); None falls back to the
+    # KATIB_COMPILE_CACHE env var, empty/unset disables.
+    compile_cache: str | None = None
 
     def parameter(self, name: str) -> ParameterSpec:
         for p in self.parameters:
@@ -679,6 +694,13 @@ class OptimalTrial:
 # consumer (orchestrator + ElasticSliceAllocator) share one definition
 # without dragging jax into metadata-only import paths.
 DEVICES_LABEL = "katib-tpu/devices"
+
+# Trial label naming the vectorized-cohort compatibility class: trials whose
+# specs carry the same value (same model, shapes, step count) may be batched
+# into one vmapped program up to ExperimentSpec.cohort_width.  Jax-free for
+# the same reason as DEVICES_LABEL — suggesters stamp it, the orchestrator
+# groups on it, runner/cohort.py executes the group.
+COHORT_KEY_LABEL = "katib-tpu/cohort-key"
 
 
 @dataclass

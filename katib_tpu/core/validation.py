@@ -133,6 +133,12 @@ def validate_experiment(spec: ExperimentSpec) -> None:
         errors.append("retry_backoff_seconds must be >= 0")
     if spec.suggester_max_errors < 1:
         errors.append("suggester_max_errors must be >= 1")
+    if spec.cohort_width < 1:
+        errors.append("cohort_width must be >= 1")
+    if spec.cohort_width > 1 and spec.command is not None:
+        # cohorts vectorize a white-box JAX program; a subprocess argv has
+        # no train step to vmap
+        errors.append("cohort_width > 1 applies to white-box train_fn trials only")
 
     if spec.train_fn is not None and spec.command is not None:
         errors.append("specify exactly one of train_fn or command, not both")

@@ -24,8 +24,11 @@ from katib_tpu.parallel.train import (
     TrainState,
     accuracy,
     cross_entropy_loss,
+    make_cohort_eval_step,
+    make_cohort_train_step,
     make_eval_step,
     make_train_step,
+    stack_pytrees,
 )
 
 
@@ -359,6 +362,120 @@ def _cached_mnist(n_train: int, n_test: int) -> Dataset:
     return _DATASET_CACHE[key]
 
 
+def _build_cohort_steps(model: nn.Module, optimizer: str):
+    def loss_fn(params, batch):
+        x, y = batch
+        return cross_entropy_loss(model.apply(params, x), y)
+
+    def metric_fn(params, batch):
+        x, y = batch
+        logits = model.apply(params, x)
+        return {
+            "accuracy": accuracy(logits, y),
+            "loss": cross_entropy_loss(logits, y),
+        }
+
+    tx = _family_optimizer(optimizer)
+    step = make_cohort_train_step(loss_fn, tx)
+    evaluate = make_cohort_eval_step(metric_fn)
+    return tx, step, evaluate
+
+
+def _cohort_steps_for(model: nn.Module, optimizer: str):
+    """Cohort twin of ``_steps_for``: same LRU, ``"cohort"``-tagged keys so
+    serial and cohort executables for one architecture coexist."""
+    try:
+        key = ("cohort", hash(model), model, optimizer)
+    except TypeError:
+        return _build_cohort_steps(model, optimizer)
+    with _STEP_CACHE_LOCK:
+        built = _STEP_CACHE.get(key)
+    if built is None:
+        fresh = _build_cohort_steps(model, optimizer)
+        with _STEP_CACHE_LOCK:
+            built = _STEP_CACHE.setdefault(key, fresh)
+    with _STEP_CACHE_LOCK:
+        if key in _STEP_CACHE:
+            _STEP_CACHE.move_to_end(key)
+        while len(_STEP_CACHE) > _STEP_CACHE_MAX:
+            _STEP_CACHE.popitem(last=False)
+    return built
+
+
+def mnist_cohort_trial(cctx) -> None:
+    """Cohort twin of ``mnist_trial``: K members differing only in lr/momentum
+    train as ONE vmapped program with stacked ``[K, ...]`` states.
+
+    Structural knobs (arch/units/batch size/…) go through ``cctx.shared`` —
+    they change the compiled program, so disagreeing members belong in
+    different cohorts.  lr/momentum ride as ``[K]`` rows inside
+    ``opt_state.hyperparams`` (the inject_hyperparams seam ``_set_hyperparams``
+    uses serially), so the executable is identical to the serial one modulo
+    the leading vmap axis.
+
+    Batch schedule mirrors ``train_classifier(seed=0)`` exactly — one
+    ``default_rng(0)`` permutation per epoch, truncated to whole batches —
+    so per-member results match a serial run of the same assignment."""
+    arch = str(cctx.shared("arch", "mlp"))
+    if arch == "cnn":
+        model = SmallCNN(channels=int(cctx.shared("channels", 32)))
+    else:
+        model = MLP(
+            units=int(cctx.shared("units", 64)),
+            num_layers=int(cctx.shared("num_layers", 2)),
+        )
+    dataset = _cached_mnist(
+        int(cctx.shared("n_train", 4096)), int(cctx.shared("n_test", 1024))
+    )
+    epochs = int(cctx.shared("epochs", 3))
+    batch_size = int(cctx.shared("batch_size", 256))
+    optimizer = str(cctx.shared("optimizer", "momentum"))
+    lrs = cctx.stacked("lr", default=0.05, dtype=jnp.float32)
+    moms = cctx.stacked("momentum", default=0.9, dtype=jnp.float32)
+
+    k = len(cctx)
+    seed = 0  # train_classifier's default — keeps cohort == serial
+    rng = np.random.default_rng(seed)
+    params = model.init(
+        jax.random.PRNGKey(seed), jnp.zeros((1, *dataset.input_shape), jnp.float32)
+    )
+    tx, step, evaluate = _cohort_steps_for(model, optimizer)
+    base = TrainState.create(params, tx)
+    state = stack_pytrees([base] * k)
+    # per-member hyperparameters as [K] runtime operands
+    hp = dict(state.opt_state.hyperparams)
+    hp["learning_rate"] = lrs
+    if "momentum" in hp:
+        hp["momentum"] = moms
+    state = state._replace(opt_state=state.opt_state._replace(hyperparams=hp))
+
+    xd = jax.device_put(dataset.x_train)
+    yd = jax.device_put(dataset.y_train)
+    scan_steps = len(dataset.x_train) // batch_size
+    ne = min(1024, len(dataset.x_test))
+    ebatch = jax.device_put((dataset.x_test[:ne], dataset.y_test[:ne]))
+
+    for epoch in range(epochs):
+        idx = rng.permutation(len(dataset.x_train))[: scan_steps * batch_size]
+        losses = []
+        for s in range(scan_steps):
+            b = jnp.asarray(idx[s * batch_size : (s + 1) * batch_size], jnp.int32)
+            # shared batch, mapped states: in_axes=(0, None) inside the step
+            state, metrics = step(state, (xd[b], yd[b]))
+            losses.append(metrics["loss"])  # [K], device future
+        train_loss = (
+            jnp.sum(jnp.stack(losses), axis=0) if losses else jnp.zeros((k,))
+        )
+        em = evaluate(state.params, ebatch)
+        cont = cctx.report(
+            step=epoch,
+            accuracy=em["accuracy"],
+            loss=train_loss / max(scan_steps, 1),
+        )
+        if not cont:
+            break
+
+
 def mnist_trial(ctx) -> None:
     """White-box trial: tunable MNIST classifier reporting accuracy/loss."""
     p = ctx.params
@@ -383,3 +500,10 @@ def mnist_trial(ctx) -> None:
         mesh=ctx.mesh,
         report=report,
     )
+
+
+# opt-in: the orchestrator batches compatible mnist_trial proposals through
+# the vmapped twin when the experiment declares a cohort (runner/cohort.py)
+from katib_tpu.runner.cohort import attach_cohort_fn  # noqa: E402
+
+attach_cohort_fn(mnist_trial, mnist_cohort_trial)

@@ -41,10 +41,29 @@ from katib_tpu.utils.booleans import parse_bool
 
 _SEARCH_META = "search_meta.json"
 
+# resolved ONCE at import: run() used to re-read the env on every call, so
+# two searches in one process could silently run with different unrolls if
+# the harness mutated the env between them; the A/B harness sets the env
+# before spawning the child, which this still honors
+_DEFAULT_SCAN_UNROLL = int(os.environ.get("KATIB_SCAN_UNROLL", "1"))
+
+
+def _persistent_cache_dir() -> str:
+    """The wired XLA persistent-cache dir ("" when disabled) — stamped on
+    first-step spans so a cache hit is visible as compile-time collapse."""
+    try:
+        import jax
+
+        return str(getattr(jax.config, "jax_compilation_cache_dir", None) or "")
+    except Exception:
+        return ""
+
 
 def _record_first_step(compile_s: float, execute_s: float, workload: str) -> None:
     """First-step latency split: under async dispatch the first jitted call
-    blocks on trace+compile, fetching its result blocks on execution."""
+    blocks on trace+compile, fetching its result blocks on execution.  With
+    the persistent compilation cache wired (KATIB_COMPILE_CACHE), a cache
+    hit shows up here as the compile phase collapsing to deserialize time."""
     obs.trial_first_step_seconds.set(compile_s, phase="compile", workload=workload)
     obs.trial_first_step_seconds.set(execute_s, phase="execute", workload=workload)
     tracing.record_span(
@@ -53,6 +72,7 @@ def _record_first_step(compile_s: float, execute_s: float, workload: str) -> Non
         workload=workload,
         compile_s=round(compile_s, 4),
         execute_s=round(execute_s, 4),
+        persistent_cache=_persistent_cache_dir(),
     )
 
 
@@ -278,9 +298,10 @@ def run_darts_search(
         # per-scan-iteration floor (artifacts/flagship/op_microbench.json),
         # and unrolling amortizes it at the cost of a proportionally
         # bigger program (longer compile, more code HBM).  Default 1;
-        # KATIB_SCAN_UNROLL overrides for the A/B harness.
+        # KATIB_SCAN_UNROLL overrides for the A/B harness (resolved once
+        # at module import, not per run).
         if scan_unroll is None:
-            scan_unroll = int(os.environ.get("KATIB_SCAN_UNROLL", "1"))
+            scan_unroll = _DEFAULT_SCAN_UNROLL
 
         if step_loop:
             # per-step on-device gather; the step itself is the separately

@@ -32,6 +32,7 @@ import time
 import traceback
 
 from katib_tpu.core.types import (
+    COHORT_KEY_LABEL as _COHORT_KEY_LABEL,
     DEVICES_LABEL as _DEVICES_LABEL,
     Experiment,
     ExperimentCondition,
@@ -43,7 +44,12 @@ from katib_tpu.core.types import (
 )
 from katib_tpu.core.validation import validate_experiment
 from katib_tpu.earlystop.rules import make_early_stopper
-from katib_tpu.runner.trial_runner import TrialResult, run_trial
+from katib_tpu.runner.cohort import cohort_fn_of, run_cohort
+from katib_tpu.runner.trial_runner import (
+    TrialResult,
+    init_compile_cache,
+    run_trial,
+)
 from katib_tpu.store.base import MemoryObservationStore, ObservationStore
 from katib_tpu.suggest.base import call_suggester, make_suggester
 from katib_tpu.utils import faults
@@ -133,6 +139,9 @@ class Orchestrator:
         if self.config is not None:
             spec = self.config.apply_to(spec)
         validate_experiment(spec)
+        # persistent XLA compilation cache (KATIB_COMPILE_CACHE env wins,
+        # spec field second); process-global, first writer wins
+        init_compile_cache(spec.compile_cache)
         if resume and experiment is None:
             experiment = self.load_experiment(spec)
         exp = experiment or Experiment(spec=spec)
@@ -197,7 +206,9 @@ class Orchestrator:
         # backoff) while in-flight trials keep running; the Nth trips the
         # breaker and fails the experiment with the last traceback
         breaker = faults.CircuitBreaker(threshold=spec.suggester_max_errors)
-        futures: dict[cf.Future, Trial] = {}
+        # value is the submitted unit: one Trial, or the member list of a
+        # vectorized cohort (runner/cohort.py) sharing a single future
+        futures: dict[cf.Future, Trial | list[Trial]] = {}
         # per-run wind-down signal for in-flight trials, set on a terminal
         # verdict or an external stop() (the reference deletes running trial
         # jobs, experiment_controller.go:362).  A fresh run() (resume) gets a
@@ -302,9 +313,21 @@ class Orchestrator:
                                 count=len(proposals),
                                 outcome=outcome,
                             )
-                        for proposal in proposals:
-                            trial = self._materialize(exp, proposal, early_stopper, suggester)
-                            futures[pool.submit(self._execute, exp, trial, mesh)] = trial
+                        for group in self._group_proposals(spec, proposals):
+                            trials = [
+                                self._materialize(exp, p, early_stopper, suggester)
+                                for p in group
+                            ]
+                            if len(trials) == 1:
+                                futures[
+                                    pool.submit(self._execute, exp, trials[0], mesh)
+                                ] = trials[0]
+                            else:
+                                # one pool slot runs the whole cohort; the
+                                # member list keeps _shortfall's budget honest
+                                futures[
+                                    pool.submit(self._execute_cohort, exp, trials, mesh)
+                                ] = trials
                         if proposals:
                             self._persist_suggester(exp, suggester)
                             # journal the newly in-flight trials so a crash here
@@ -436,6 +459,88 @@ class Orchestrator:
     #: allocator only) — suggesters/users raise it per rung the way
     #: Hyperband raises epochs; one shared jax-free definition in core.types
     DEVICES_LABEL = _DEVICES_LABEL
+
+    def _group_proposals(self, spec: ExperimentSpec, proposals: list) -> list[list]:
+        """Partition a batch of proposals into cohort groups (each submitted
+        as ONE vmap-batched program, ``runner/cohort.py``).
+
+        Grouping needs ``cohort_width > 1`` AND a train_fn with a declared
+        cohort twin.  Compatibility key: the per-proposal
+        ``katib-tpu/cohort-key`` label (suggesters stamp it when members
+        must share a compiled program), falling back to the spec-wide
+        ``cohort_key``; keyless proposals stay singletons.  The key is
+        stamped back into the proposal labels so the journal/UI show which
+        cohort a trial rode in."""
+        if spec.cohort_width <= 1 or cohort_fn_of(spec.train_fn) is None:
+            return [[p] for p in proposals]
+        groups: list[list] = []
+        buckets: dict[str, list] = {}
+        for p in proposals:
+            key = p.labels.get(_COHORT_KEY_LABEL) or spec.cohort_key
+            if not key:
+                groups.append([p])
+                continue
+            p.labels.setdefault(_COHORT_KEY_LABEL, key)
+            buckets.setdefault(key, []).append(p)
+        for bucket in buckets.values():
+            for i in range(0, len(bucket), spec.cohort_width):
+                groups.append(bucket[i : i + spec.cohort_width])
+        return groups
+
+    def _execute_cohort(self, exp: Experiment, trials: list[Trial], mesh):
+        """Run a cohort on one pool thread; returns ``{name: TrialResult}``.
+        Never raises (harvest calls ``f.result()`` bare).
+
+        Retry semantics for members mirror the serial ``_execute_with_retry``
+        families, but a retried member REJOINS AS A SINGLETON: its cohort
+        peers have already finished, so the re-run goes through the ordinary
+        serial path (same name + checkpoint dir, full remaining budget)."""
+        with tracing.use_tracer(self._tracer):
+            try:
+                results = run_cohort(
+                    trials,
+                    self.store,
+                    exp.spec.objective,
+                    mesh=mesh,
+                    stop_event=self._stop_event,
+                    injector=self.fault_injector,
+                )
+            except Exception as e:  # defense: run_cohort itself never raises
+                results = {
+                    t.name: TrialResult(
+                        TrialCondition.FAILED,
+                        traceback.format_exc(limit=20),
+                        failure_kind=faults.classify_exception(e),
+                    )
+                    for t in trials
+                }
+            for t in trials:
+                r = results.get(t.name)
+                if r is None:
+                    results[t.name] = TrialResult(
+                        TrialCondition.FAILED,
+                        "cohort returned no result for member",
+                        failure_kind=faults.FailureKind.PERMANENT,
+                    )
+                    continue
+                if (
+                    r.condition is TrialCondition.FAILED
+                    and r.failure_kind is faults.FailureKind.TRANSIENT
+                    and t.retry_count < t.spec.max_retries
+                    and not self._stop_event.is_set()
+                ):
+                    t.retry_count += 1
+                    t.failure_kind = faults.FailureKind.TRANSIENT.value
+                    obs.trials_retried.inc(kind=faults.FailureKind.TRANSIENT.value)
+                    self._publish(exp)
+                    results[t.name] = self._execute(exp, t, mesh)
+                elif (
+                    r.condition is TrialCondition.METRICS_UNAVAILABLE
+                    and t.spec.metrics_retries > 0
+                    and not self._stop_event.is_set()
+                ):
+                    results[t.name] = self._execute(exp, t, mesh)
+            return results
 
     def _execute(self, exp: Experiment, trial: Trial, mesh):
         # invariant: never raises — _harvest calls f.result() bare.
@@ -669,33 +774,48 @@ class Orchestrator:
         if wait_running and futures:
             done = list(cf.wait(list(futures)).done)
         for f in done:
-            trial = futures.pop(f)
+            # A future owns either one trial (serial) or a list (cohort);
+            # cohort futures resolve to a {name: TrialResult} dict.
+            owner = futures.pop(f)
+            members = owner if isinstance(owner, list) else [owner]
             if f.cancelled():
-                trial.condition = TrialCondition.KILLED
-                trial.completion_time = time.time()
-                obs.trials_killed.inc()
-                self._observe_trial_duration(trial)
+                for trial in members:
+                    trial.condition = TrialCondition.KILLED
+                    trial.completion_time = time.time()
+                    obs.trials_killed.inc()
+                    self._observe_trial_duration(trial)
                 continue
-            result = f.result()  # _execute never raises
-            trial.condition = result.condition
-            trial.message = result.message
-            fk = getattr(result, "failure_kind", None)
-            trial.failure_kind = fk.value if fk is not None else None
-            trial.completion_time = time.time()
-            if trial.condition in (
-                TrialCondition.SUCCEEDED,
-                TrialCondition.EARLY_STOPPED,
-            ):
-                trial.observation = self.store.observation_for(
-                    trial.name, exp.spec.objective
-                )
-                if trial.observation is None:
-                    trial.condition = TrialCondition.METRICS_UNAVAILABLE
-            counter = self._TRIAL_COUNTERS.get(trial.condition)
-            if counter is not None:
-                counter.inc()
-            self._observe_trial_duration(trial)
-            self._cleanup_trial(trial)
+            result = f.result()  # _execute / _execute_cohort never raise
+            results = (
+                result if isinstance(result, dict) else {members[0].name: result}
+            )
+            for trial in members:
+                res = results.get(trial.name)
+                if res is None:  # defense: _execute_cohort backfills missing
+                    res = TrialResult(
+                        TrialCondition.FAILED,
+                        "cohort returned no result for member",
+                        failure_kind=faults.FailureKind.PERMANENT,
+                    )
+                trial.condition = res.condition
+                trial.message = res.message
+                fk = getattr(res, "failure_kind", None)
+                trial.failure_kind = fk.value if fk is not None else None
+                trial.completion_time = time.time()
+                if trial.condition in (
+                    TrialCondition.SUCCEEDED,
+                    TrialCondition.EARLY_STOPPED,
+                ):
+                    trial.observation = self.store.observation_for(
+                        trial.name, exp.spec.objective
+                    )
+                    if trial.observation is None:
+                        trial.condition = TrialCondition.METRICS_UNAVAILABLE
+                counter = self._TRIAL_COUNTERS.get(trial.condition)
+                if counter is not None:
+                    counter.inc()
+                self._observe_trial_duration(trial)
+                self._cleanup_trial(trial)
             exp.update_optimal()
         if done:
             self._publish(exp)
@@ -735,7 +855,11 @@ class Orchestrator:
         keep ``parallel_trial_count`` active, never exceed ``max_trial_count``
         counting every terminal trial plus the ones in flight."""
         spec = exp.spec
-        active = len(futures)
+        # Cohort futures carry multiple trials on one pool slot; the budget
+        # counts members, not futures.
+        active = sum(
+            len(v) if isinstance(v, list) else 1 for v in futures.values()
+        )
         slots = spec.parallel_trial_count - active
         if spec.max_trial_count is not None:
             slots = min(slots, spec.max_trial_count - self._budget_used(exp) - active)

@@ -88,6 +88,92 @@ def make_train_step(
     )
 
 
+# -- vectorized trial cohorts -------------------------------------------------
+
+
+def stack_pytrees(trees):
+    """Stack K structurally identical pytrees into one ``[K, ...]`` pytree
+    (member k of the cohort lives at leading-axis row k)."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+
+
+def unstack_pytree(tree, k: int):
+    """Inverse of :func:`stack_pytrees`: one ``[K, ...]`` pytree -> K pytrees."""
+    return [jax.tree_util.tree_map(lambda x: x[i], tree) for i in range(k)]
+
+
+class _TraceCounter:
+    """Counts traces of the cohort step — the Python body runs once per jit
+    trace, so tests can assert a K-member cohort compiles exactly one
+    program instead of K."""
+
+    def __init__(self) -> None:
+        self.count = 0
+
+    def bump(self) -> None:
+        self.count += 1
+
+
+cohort_trace_counter = _TraceCounter()
+
+
+def make_cohort_train_step(
+    loss_fn: Callable[..., jnp.ndarray],
+    tx: optax.GradientTransformation,
+    donate: bool = True,
+    grad_clip_norm: float | None = None,
+) -> Callable:
+    """Build ``step(states, batch) -> (states, metrics)`` over a whole cohort.
+
+    ``states`` is a stacked ``[K, ...]`` TrainState pytree (one member per
+    leading row); the batch is shared across members.  Per-member
+    hyperparameters ride inside each member's opt_state as runtime values
+    (``optax.inject_hyperparams``), so the K members — and every later
+    cohort of the same shapes — share this single compiled executable; the
+    carried state is donated so the device buffers are reused in place.
+
+    Divergence is contained per member: a row whose loss goes non-finite
+    keeps its previous state (its metrics stay non-finite from then on), so
+    one blown-up member never poisons the rest of the cohort.
+    """
+
+    def member_step(state: TrainState, batch) -> tuple[TrainState, dict]:
+        def wrapped(params):
+            out = loss_fn(params, batch)
+            if isinstance(out, tuple):
+                return out
+            return out, {}
+
+        (loss, aux), grads = jax.value_and_grad(wrapped, has_aux=True)(state.params)
+        if grad_clip_norm is not None:
+            grads, _ = clip_by_global_norm(grads, grad_clip_norm)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        metrics = {"loss": loss, **aux}
+        return TrainState(state.step + 1, params, opt_state), metrics
+
+    vstep = jax.vmap(member_step, in_axes=(0, None))
+
+    def step(states: TrainState, batch) -> tuple[TrainState, dict]:
+        cohort_trace_counter.bump()
+        new_states, metrics = vstep(states, batch)
+        ok = jnp.isfinite(metrics["loss"])
+
+        def pick(new, old):
+            mask = ok.reshape(ok.shape + (1,) * (new.ndim - 1))
+            return jnp.where(mask, new, old)
+
+        return jax.tree_util.tree_map(pick, new_states, states), metrics
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def make_cohort_eval_step(metric_fn: Callable[..., dict]) -> Callable:
+    """Build ``eval(params, batch) -> metrics`` vmapped over stacked
+    ``[K, ...]`` params with a shared batch; each returned metric is ``[K]``."""
+    return jax.jit(jax.vmap(metric_fn, in_axes=(0, None)))
+
+
 def make_eval_step(
     metric_fn: Callable[..., dict],
     mesh: Mesh | None = None,

@@ -1,0 +1,314 @@
+"""Vectorized trial cohorts: K compatible trials, one compiled program.
+
+Small-model hyperparameter sweeps are dominated by per-trial overhead —
+XLA recompiles the same training step once per trial and Python dispatch
+is paid K times per step.  A *cohort* lifts the K members' hyperparameters
+into dynamic array operands (a stacked ``[K, ...]`` state pytree whose
+opt-state carries per-member learning rates via
+``optax.inject_hyperparams``) and trains all members in ONE jitted
+``vmap``'d step with donated carried state (see
+``parallel/train.py:make_cohort_train_step``).  The first member pays the
+trace; members 2..K — and every later cohort of the same shapes — reuse
+the executable.
+
+The cohort is an *execution* batch, not a semantic one: each member keeps
+its own trial identity.  Metric rows are unstacked per member into the
+normal ``ObservationStore`` path, early-stopping rules evaluate per
+member, and a member whose objective goes non-finite fails alone
+(``Permanent``, "diverged") while its lane is frozen in-step so it cannot
+poison the rest (the ``jnp.where`` guard in ``make_cohort_train_step``).
+
+A train function opts in by attaching a cohort-capable twin::
+
+    def my_trial(ctx): ...            # normal TrialContext path
+    def my_cohort(cctx): ...          # CohortContext path, trains all K
+    attach_cohort_fn(my_trial, my_cohort)
+
+``run_cohort`` falls back to per-member serial ``run_trial`` whenever the
+cohort path is unavailable (K == 1, no cohort fn) or blows up mid-flight —
+cohort mode is never worse than serial, just slower on the fallback.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Any, Callable, Mapping, Sequence
+
+import numpy as np
+
+from katib_tpu.core.types import COHORT_KEY_LABEL, MetricLog, Trial, TrialCondition
+from katib_tpu.earlystop.rules import RuleEvaluator
+from katib_tpu.runner.trial_runner import TrialResult, _finalize, run_trial
+from katib_tpu.store.base import ObservationStore
+from katib_tpu.utils import observability as obs
+from katib_tpu.utils import tracing
+from katib_tpu.utils.faults import FailureKind, classify_exception
+
+_COHORT_ATTR = "__cohort_fn__"
+
+
+def attach_cohort_fn(train_fn: Callable, cohort_fn: Callable) -> Callable:
+    """Declare ``cohort_fn(cctx)`` as the vectorized twin of ``train_fn(ctx)``.
+    Returns ``train_fn`` so it can be used as a decorator-style one-liner."""
+    setattr(train_fn, _COHORT_ATTR, cohort_fn)
+    return train_fn
+
+
+def cohort_fn_of(train_fn: Callable | None) -> Callable | None:
+    """The cohort-capable twin of ``train_fn``, or None when it never
+    opted in (black-box commands and plain train_fns stay serial)."""
+    if train_fn is None:
+        return None
+    return getattr(train_fn, _COHORT_ATTR, None)
+
+
+class CohortContext:
+    """What a cohort_fn sees: the members' hyperparameters (stackable into
+    ``[K]`` operand arrays), a batched ``report`` that unstacks metric rows
+    per member, and per-member failure/early-stop bookkeeping."""
+
+    def __init__(
+        self,
+        members: Sequence[Trial],
+        store: ObservationStore,
+        objective,
+        mesh: Any = None,
+        stop_event: threading.Event | None = None,
+    ):
+        self.members = list(members)
+        self.params_list = [t.params() for t in self.members]
+        self.labels_list = [dict(t.spec.labels) for t in self.members]
+        self.checkpoint_dirs = [t.checkpoint_dir for t in self.members]
+        self.mesh = mesh
+        self._store = store
+        self._objective = objective
+        self._stop_event = stop_event
+        self._evaluators = [
+            RuleEvaluator(t.spec.early_stopping_rules, objective)
+            for t in self.members
+        ]
+        k = len(self.members)
+        self._failed: list[tuple[str, FailureKind] | None] = [None] * k
+        self._early_stopped: list[bool] = [False] * k
+        self._step = 0
+        # cooperative wall-clock bound like TrialContext: the tightest
+        # member deadline bounds the whole cohort (members share one program)
+        runtimes = [
+            t.spec.max_runtime_seconds
+            for t in self.members
+            if t.spec.max_runtime_seconds is not None
+        ]
+        self._deadline = time.monotonic() + min(runtimes) if runtimes else None
+
+    # -- member hyperparameters -------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self.members)
+
+    def stacked(self, name: str, default: Any = None, dtype=None):
+        """Per-member values of parameter ``name`` as a ``[K]`` jnp array —
+        the dynamic operand that rides inside the vmapped program."""
+        import jax.numpy as jnp
+
+        vals = [p.get(name, default) for p in self.params_list]
+        return jnp.asarray(vals, dtype=dtype)
+
+    def shared(self, name: str, default: Any = None) -> Any:
+        """A parameter every member must agree on (model shape, batch size —
+        anything that changes the compiled program).  Raises when members
+        disagree: such trials belong in different cohorts."""
+        vals = [p.get(name, default) for p in self.params_list]
+        if any(v != vals[0] for v in vals[1:]):
+            raise ValueError(
+                f"cohort members disagree on structural parameter {name!r}: {vals} "
+                "(group them under different cohort keys)"
+            )
+        return vals[0]
+
+    # -- reporting ---------------------------------------------------------
+
+    def report(self, step: int | None = None, **metrics) -> bool:
+        """Report one ``[K]`` row per metric; returns True while any member
+        is still alive and the cohort should keep training.
+
+        Row ``i`` of each value belongs to member ``i``.  A member whose
+        objective metric comes back non-finite is failed ``Permanent``
+        ("diverged" — the identical re-run would diverge again); non-finite
+        values are never written to the store so reductions stay clean.
+        """
+        if step is None:
+            step = self._step
+            self._step += 1
+        else:
+            self._step = step + 1
+        k = len(self.members)
+        rows: dict[str, np.ndarray] = {}
+        for name, value in metrics.items():
+            arr = np.asarray(value, dtype=float).reshape(-1)
+            if arr.size == 1:
+                arr = np.full(k, arr[0])
+            if arr.size != k:
+                raise ValueError(
+                    f"metric {name!r} has {arr.size} rows for a {k}-member cohort"
+                )
+            rows[name] = arr
+        obj_name = self._objective.objective_metric_name
+        now = time.time()
+        for i, trial in enumerate(self.members):
+            if not self.alive(i):
+                continue
+            if obj_name in rows and not np.isfinite(rows[obj_name][i]):
+                self.fail_member(
+                    i,
+                    f"objective metric {obj_name!r} went non-finite at step "
+                    f"{step} (diverged)",
+                )
+                continue
+            logs = [
+                MetricLog(metric_name=n, value=float(v[i]), timestamp=now, step=step)
+                for n, v in rows.items()
+                if np.isfinite(v[i])
+            ]
+            if logs:
+                self._store.report(trial.name, logs)
+            ev = self._evaluators[i]
+            for log in logs:
+                ev.observe(log.metric_name, log.value)
+            if ev.should_stop():
+                self._early_stopped[i] = True
+        return not self.should_stop()
+
+    # -- member lifecycle --------------------------------------------------
+
+    def alive(self, i: int) -> bool:
+        """True while member ``i`` still wants training steps."""
+        return self._failed[i] is None and not self._early_stopped[i]
+
+    def fail_member(self, i: int, message: str, transient: bool = False) -> None:
+        """Fail member ``i`` alone; the rest of the cohort keeps training.
+        ``transient=True`` marks it retryable (the orchestrator re-runs it
+        as a singleton trial)."""
+        if self._failed[i] is None:
+            kind = FailureKind.TRANSIENT if transient else FailureKind.PERMANENT
+            self._failed[i] = (message, kind)
+
+    def should_stop(self) -> bool:
+        """True when the whole cohort should wind down: every member is
+        done (failed/early-stopped), the experiment hit a terminal state,
+        or the wall-clock bound passed."""
+        if not any(self.alive(i) for i in range(len(self.members))):
+            return True
+        if self.deadline_exceeded():
+            return True
+        return self._stop_event is not None and self._stop_event.is_set()
+
+    def deadline_exceeded(self) -> bool:
+        return self._deadline is not None and time.monotonic() > self._deadline
+
+    # -- settlement (run_cohort internals) ---------------------------------
+
+    def _settle(self, i: int) -> TrialResult:
+        """Terminal condition for member ``i`` after the cohort fn returned,
+        mirroring the serial ``_run_whitebox`` postamble ordering."""
+        if self._failed[i] is not None:
+            message, kind = self._failed[i]
+            return TrialResult(TrialCondition.FAILED, message, failure_kind=kind)
+        if self._early_stopped[i]:
+            triggered = self._evaluators[i].triggered
+            return TrialResult(
+                TrialCondition.EARLY_STOPPED,
+                triggered.describe() if triggered is not None else "early stopped",
+            )
+        if self.deadline_exceeded():
+            return TrialResult(
+                TrialCondition.FAILED,
+                "cohort exceeded max_runtime_seconds",
+                failure_kind=FailureKind.PERMANENT,
+            )
+        if self._stop_event is not None and self._stop_event.is_set():
+            return TrialResult(
+                TrialCondition.KILLED, "experiment reached terminal state"
+            )
+        return _finalize(self.members[i], self._store, self._objective)
+
+
+def run_cohort(
+    trials: Sequence[Trial],
+    store: ObservationStore,
+    objective,
+    mesh=None,
+    stop_event: threading.Event | None = None,
+    injector=None,
+) -> dict[str, TrialResult]:
+    """Execute K trials as one vectorized cohort; returns a per-trial-name
+    result map.  Never raises: a cohort-path failure falls back to serial
+    per-member execution, and member failures are isolated results.
+    """
+    results: dict[str, TrialResult] = {}
+    if not trials:
+        return results
+    cohort_fn = cohort_fn_of(trials[0].spec.train_fn)
+    if len(trials) == 1 or cohort_fn is None:
+        for t in trials:
+            results[t.name] = run_trial(t, store, objective, mesh, stop_event, injector)
+        return results
+
+    # chaos seam parity with run_trial: injected faults fire per member and
+    # fail only that member; survivors still train as a (smaller) cohort
+    survivors: list[Trial] = []
+    for t in trials:
+        if injector is not None:
+            try:
+                injector.on_trial_attempt(t)
+                injector.apply_metrics_delay(t, stop_event)
+            except Exception as e:
+                results[t.name] = TrialResult(
+                    TrialCondition.FAILED,
+                    traceback.format_exc(limit=20),
+                    failure_kind=classify_exception(e),
+                )
+                continue
+        survivors.append(t)
+    if not survivors:
+        return results
+    if len(survivors) == 1:
+        t = survivors[0]
+        results[t.name] = run_trial(t, store, objective, mesh, stop_event)
+        return results
+
+    k = len(survivors)
+    key = survivors[0].spec.labels.get(COHORT_KEY_LABEL, "")
+    ctx = CohortContext(survivors, store, objective, mesh=mesh, stop_event=stop_event)
+    started = time.perf_counter()
+    try:
+        with tracing.span("cohort", size=k, key=key):
+            cohort_fn(ctx)
+    except Exception:
+        # the vectorized path is an optimization, never a correctness
+        # dependency: re-run every member serially (duplicate metric rows
+        # from the partial cohort are tolerated by the store's reduction)
+        obs.cohort_fallbacks.inc()
+        for t in survivors:
+            results[t.name] = run_trial(t, store, objective, mesh, stop_event)
+        return results
+    elapsed = max(time.perf_counter() - started, 1e-9)
+
+    obs.cohorts_executed.inc()
+    obs.cohort_size.observe(float(k))
+    obs.cohort_trials_per_sec.set(k / elapsed)
+    per_member = elapsed / k
+    for i, t in enumerate(survivors):
+        results[t.name] = ctx._settle(i)
+        # per-member span so trial-level trace analysis (and the CI
+        # observability smoke) sees cohort members as ordinary trials
+        tracing.record_span(
+            "trial",
+            per_member,
+            trial=t.name,
+            condition=results[t.name].condition.value,
+            cohort=key,
+            cohort_size=k,
+        )
+    return results

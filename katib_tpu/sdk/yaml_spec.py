@@ -343,6 +343,13 @@ def experiment_spec_from_dict(data: Mapping[str, Any]) -> ExperimentSpec:
         max_retries=int(spec.get("maxRetries", 0)),
         retry_backoff_seconds=float(spec.get("retryBackoffSeconds", 1.0)),
         suggester_max_errors=int(spec.get("suggesterMaxErrors", 5)),
+        cohort_width=int(spec.get("cohortWidth", 1)),
+        cohort_key=(
+            str(spec["cohortKey"]) if spec.get("cohortKey") is not None else None
+        ),
+        compile_cache=(
+            str(spec["compileCache"]) if spec.get("compileCache") is not None else None
+        ),
     )
 
 

@@ -609,6 +609,7 @@ async function counters(){
     `${tot('katib_trial_early_stopped_total')} early-stopped · `+
     `experiments running: ${tot('katib_experiments_current')}`+
     (tot('katib_suggester_errors_total')?` · suggester errors: ${tot('katib_suggester_errors_total')}`:'')+
+    (tot('katib_cohort_executed_total')?` · cohorts: ${tot('katib_cohort_executed_total')}`:'')+
     (mean!==null?` · mean trial ${mean.toFixed(1)}s`:'')+'</small>';
 }
 async function refresh(){

@@ -407,6 +407,31 @@ device_hbm_bytes = REGISTRY.gauge(
     "Per-device bytes in use (jax device memory_stats, where available)",
 )
 
+# -- vectorized trial cohorts (runner/cohort.py) ------------------------------
+
+cohorts_executed = REGISTRY.counter(
+    "katib_cohort_executed_total",
+    "Vectorized trial cohorts executed (vmap-batched multi-trial programs)",
+)
+cohort_size = REGISTRY.histogram(
+    "katib_cohort_size",
+    "Member trials per vectorized cohort",
+    buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0),
+)
+cohort_trials_per_sec = REGISTRY.gauge(
+    "katib_cohort_trials_per_sec",
+    "Member-trial throughput of the most recent cohort execution",
+)
+cohort_fallbacks = REGISTRY.counter(
+    "katib_cohort_fallback_total",
+    "Cohorts whose vectorized path failed and re-ran members serially",
+)
+compile_cache_enabled = REGISTRY.gauge(
+    "katib_compile_cache_enabled",
+    "1 when the persistent XLA compilation cache is wired "
+    "(KATIB_COMPILE_CACHE / ExperimentSpec.compile_cache)",
+)
+
 
 def record_device_memory(registry_gauge: _Metric | None = None) -> None:
     """Best-effort per-device memory gauges via ``Device.memory_stats()``
