@@ -48,6 +48,9 @@ Env knobs:
                           as the scripts/ harnesses)
   BENCH_COHORT_K          --cohort mode: members per cohort (default 8)
   BENCH_COHORT_STEPS      --cohort mode: timed steps (default 200, small: 50)
+  BENCH_COHORT_DEVICES    --cohort mode: devices on the trial axis (default 1;
+                          the --cohort-devices N flag sets this plus the
+                          virtual-device XLA flag for the child)
 
 ``python bench.py --cohort`` runs a separate measurement: serial vs
 vmap-batched cohort trial throughput (``runner/cohort.py``) on a tiny
@@ -633,6 +636,12 @@ def _cohort_child() -> None:
     import jax.numpy as jnp
     import optax
 
+    from katib_tpu.parallel.mesh import (
+        TRIAL_AXIS,
+        make_mesh,
+        padded_cohort_size,
+        shard_members,
+    )
     from katib_tpu.parallel.train import (
         TrainState,
         make_cohort_train_step,
@@ -647,6 +656,21 @@ def _cohort_child() -> None:
 
     k = int(os.environ.get("BENCH_COHORT_K", "8"))
     steps = int(os.environ.get("BENCH_COHORT_STEPS", "50" if _SMALL else "200"))
+    devices = int(os.environ.get("BENCH_COHORT_DEVICES", "1"))
+    mesh = None
+    if devices > 1:
+        devs = jax.devices()
+        if len(devs) < devices:
+            # a backend that ignores the forced-host-platform flag (real
+            # TPU pool) can't carve the trial axis; fall back honestly
+            print(
+                f"bench: only {len(devs)} devices for --cohort-devices "
+                f"{devices}; measuring single-device cohort",
+                file=sys.stderr,
+            )
+            devices = 1
+        else:
+            mesh = make_mesh({TRIAL_AXIS: devices}, devices=devs[:devices])
     dim, nbatch = 32, 256
 
     key = jax.random.PRNGKey(0)
@@ -677,14 +701,27 @@ def _cohort_child() -> None:
         hp["learning_rate"] = jnp.asarray(lr, jnp.float32)
         return s._replace(opt_state=s.opt_state._replace(hyperparams=hp))
 
+    # ghost-pad the member dimension to fill the trial axis (k itself stays
+    # the trials/sec denominator — ghosts are execution filler, not trials)
+    k_exec = padded_cohort_size(k, mesh)
+    exec_lrs = lrs + lrs[: k_exec - k]
+
     def cohort_state():
-        s = stack_pytrees([TrainState.create(params, tx)] * k)
+        s = stack_pytrees([TrainState.create(params, tx)] * k_exec)
         hp = dict(s.opt_state.hyperparams)
-        hp["learning_rate"] = jnp.asarray(lrs, jnp.float32)
-        return s._replace(opt_state=s.opt_state._replace(hyperparams=hp))
+        hp["learning_rate"] = jnp.asarray(exec_lrs, jnp.float32)
+        s = s._replace(opt_state=s.opt_state._replace(hyperparams=hp))
+        return shard_members(s, mesh) if mesh is not None else s
 
     serial_step = make_train_step(loss_fn, tx)
-    cohort_step = make_cohort_train_step(loss_fn, tx)
+    cohort_step = make_cohort_train_step(loss_fn, tx, mesh=mesh)
+    cohort_batch = (
+        jax.device_put(batch, jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec()
+        ))
+        if mesh is not None
+        else batch
+    )
 
     # warm both traces outside the clocks (steps donate their state input)
     s = member_state(0.01)
@@ -693,7 +730,7 @@ def _cohort_child() -> None:
     jax.block_until_ready(s)
     c = cohort_state()
     for _ in range(3):
-        c, _m = cohort_step(c, batch)
+        c, _m = cohort_step(c, cohort_batch)
     jax.block_until_ready(c)
 
     t0 = time.perf_counter()
@@ -709,7 +746,7 @@ def _cohort_child() -> None:
     t0 = time.perf_counter()
     c = cohort_state()
     for _ in range(steps):
-        c, _m = cohort_step(c, batch)
+        c, _m = cohort_step(c, cohort_batch)
     jax.block_until_ready(c)
     t_cohort = time.perf_counter() - t0
 
@@ -724,6 +761,8 @@ def _cohort_child() -> None:
                 "cohort_trials_per_sec": round(cohort_tps, 3),
                 "speedup": round(cohort_tps / serial_tps, 2),
                 "k": k,
+                "devices": devices,
+                "members_per_device": k_exec // max(devices, 1),
                 "steps": steps,
                 "platform": platform,
             }
@@ -734,10 +773,24 @@ def _cohort_child() -> None:
 def _run_cohort() -> None:
     """Parent side of ``--cohort``: run the measurement in a child (scrubbed
     env, CPU by default — this is a dispatch-overhead benchmark, not a chip
-    benchmark) and print its JSON line."""
+    benchmark) and print its JSON line.  ``--cohort-devices N`` shards the
+    cohort's trial axis over N virtual CPU devices (the child gets the
+    forced-host-platform flag), recording trials/sec vs device count."""
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the relay
     env.setdefault("JAX_PLATFORMS", "cpu")
+    if "--cohort-devices" in sys.argv:
+        try:
+            n = int(sys.argv[sys.argv.index("--cohort-devices") + 1])
+        except (IndexError, ValueError):
+            print("bench: --cohort-devices needs an integer", file=sys.stderr)
+            sys.exit(2)
+        env["BENCH_COHORT_DEVICES"] = str(n)
+        flags = env.get("XLA_FLAGS", "")
+        if n > 1 and "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
     proc = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), "--cohort-child"],
         stdout=subprocess.PIPE,

@@ -362,7 +362,7 @@ def _cached_mnist(n_train: int, n_test: int) -> Dataset:
     return _DATASET_CACHE[key]
 
 
-def _build_cohort_steps(model: nn.Module, optimizer: str):
+def _build_cohort_steps(model: nn.Module, optimizer: str, mesh=None):
     def loss_fn(params, batch):
         x, y = batch
         return cross_entropy_loss(model.apply(params, x), y)
@@ -376,22 +376,24 @@ def _build_cohort_steps(model: nn.Module, optimizer: str):
         }
 
     tx = _family_optimizer(optimizer)
-    step = make_cohort_train_step(loss_fn, tx)
-    evaluate = make_cohort_eval_step(metric_fn)
+    step = make_cohort_train_step(loss_fn, tx, mesh=mesh)
+    evaluate = make_cohort_eval_step(metric_fn, mesh=mesh)
     return tx, step, evaluate
 
 
-def _cohort_steps_for(model: nn.Module, optimizer: str):
+def _cohort_steps_for(model: nn.Module, optimizer: str, mesh=None):
     """Cohort twin of ``_steps_for``: same LRU, ``"cohort"``-tagged keys so
-    serial and cohort executables for one architecture coexist."""
+    serial and cohort executables for one architecture coexist (the mesh is
+    part of the key — a trial-sharded executable must never serve a
+    single-device cohort or vice versa)."""
     try:
-        key = ("cohort", hash(model), model, optimizer)
+        key = ("cohort", hash(model), model, optimizer, _mesh_key(mesh))
     except TypeError:
-        return _build_cohort_steps(model, optimizer)
+        return _build_cohort_steps(model, optimizer, mesh)
     with _STEP_CACHE_LOCK:
         built = _STEP_CACHE.get(key)
     if built is None:
-        fresh = _build_cohort_steps(model, optimizer)
+        fresh = _build_cohort_steps(model, optimizer, mesh)
         with _STEP_CACHE_LOCK:
             built = _STEP_CACHE.setdefault(key, fresh)
     with _STEP_CACHE_LOCK:
@@ -415,7 +417,13 @@ def mnist_cohort_trial(cctx) -> None:
 
     Batch schedule mirrors ``train_classifier(seed=0)`` exactly — one
     ``default_rng(0)`` permutation per epoch, truncated to whole batches —
-    so per-member results match a serial run of the same assignment."""
+    so per-member results match a serial run of the same assignment.
+
+    On a mesh with a ``trial`` axis the stacked member dimension is padded
+    to ``cctx.padded_size`` (ghost rows ride member 0's hyperparameters)
+    and device-put onto the trial-sharded layout; the shared train/eval
+    splits are replicated.  ``cctx.report`` drops the ghost rows, so the
+    observation path is identical to the single-device cohort."""
     arch = str(cctx.shared("arch", "mlp"))
     if arch == "cnn":
         model = SmallCNN(channels=int(cctx.shared("channels", 32)))
@@ -433,27 +441,28 @@ def mnist_cohort_trial(cctx) -> None:
     lrs = cctx.stacked("lr", default=0.05, dtype=jnp.float32)
     moms = cctx.stacked("momentum", default=0.9, dtype=jnp.float32)
 
-    k = len(cctx)
+    k = cctx.padded_size  # == len(cctx) without a trial axis
     seed = 0  # train_classifier's default — keeps cohort == serial
     rng = np.random.default_rng(seed)
     params = model.init(
         jax.random.PRNGKey(seed), jnp.zeros((1, *dataset.input_shape), jnp.float32)
     )
-    tx, step, evaluate = _cohort_steps_for(model, optimizer)
+    tx, step, evaluate = _cohort_steps_for(model, optimizer, cctx.cohort_mesh)
     base = TrainState.create(params, tx)
     state = stack_pytrees([base] * k)
-    # per-member hyperparameters as [K] runtime operands
+    # per-member hyperparameters as [K] runtime operands (stacked() pads
+    # ghost rows with member 0's values)
     hp = dict(state.opt_state.hyperparams)
     hp["learning_rate"] = lrs
     if "momentum" in hp:
         hp["momentum"] = moms
     state = state._replace(opt_state=state.opt_state._replace(hyperparams=hp))
+    state = cctx.place_members(state)
 
-    xd = jax.device_put(dataset.x_train)
-    yd = jax.device_put(dataset.y_train)
+    xd, yd = cctx.place_shared((dataset.x_train, dataset.y_train))
     scan_steps = len(dataset.x_train) // batch_size
     ne = min(1024, len(dataset.x_test))
-    ebatch = jax.device_put((dataset.x_test[:ne], dataset.y_test[:ne]))
+    ebatch = cctx.place_shared((dataset.x_test[:ne], dataset.y_test[:ne]))
 
     for epoch in range(epochs):
         idx = rng.permutation(len(dataset.x_train))[: scan_steps * batch_size]

@@ -285,6 +285,31 @@ def run_darts_search(
     # Dispatches stay async (losses fetched once per epoch), batch
     # composition and augmentation keying are identical to the scan path.
     step_loop = parse_bool(os.environ.get("KATIB_STEP_LOOP"))
+    if step_loop and not device_data:
+        # step-loop mode only exists inside the device-data path; a silent
+        # fallback here once burned a TPU window on the wrong program shape
+        # (the epoch-scan compile it was set to avoid), so say why it is
+        # inert instead of quietly ignoring the flag
+        import warnings
+
+        reasons = []
+        if mesh is not None:
+            reasons.append("a device mesh is set")
+        if prefetch_requested:
+            reasons.append("native prefetch was requested")
+        env_dd = os.environ.get("KATIB_DEVICE_DATA")
+        if env_dd is not None and not parse_bool(env_dd):
+            reasons.append("KATIB_DEVICE_DATA=0 disables the device-data path")
+        if scan_steps < 1:
+            reasons.append("the train split is smaller than one batch")
+        warnings.warn(
+            "KATIB_STEP_LOOP=1 is set but the device-data path is inactive ("
+            + ("; ".join(reasons) or "device_data resolved to False")
+            + ") — falling back to the streamed per-batch loop, NOT the "
+            "single-step device-resident loop",
+            RuntimeWarning,
+            stacklevel=2,
+        )
     gather_batches = None
     if device_data:
         # splits live in HBM for the whole search; the epoch is one jitted

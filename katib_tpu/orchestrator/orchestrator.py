@@ -223,6 +223,7 @@ class Orchestrator:
         # and the status journal before surfacing
         try:
             mesh = self._resolve_mesh(spec)
+            self._validate_mesh(spec, mesh)
         except Exception:
             exp.condition = ExperimentCondition.FAILED
             exp.message = "mesh config error:\n" + traceback.format_exc(limit=5)
@@ -313,7 +314,7 @@ class Orchestrator:
                                 count=len(proposals),
                                 outcome=outcome,
                             )
-                        for group in self._group_proposals(spec, proposals):
+                        for group in self._group_proposals(spec, proposals, mesh):
                             trials = [
                                 self._materialize(exp, p, early_stopper, suggester)
                                 for p in group
@@ -460,31 +461,70 @@ class Orchestrator:
     #: Hyperband raises epochs; one shared jax-free definition in core.types
     DEVICES_LABEL = _DEVICES_LABEL
 
-    def _group_proposals(self, spec: ExperimentSpec, proposals: list) -> list[list]:
+    def _validate_mesh(self, spec: ExperimentSpec, mesh) -> None:
+        """Mesh/spec cross-checks that only the orchestrator can make (spec
+        validation never sees the mesh): a ``trial`` axis shards vmap-batched
+        cohort members, which only white-box train_fn trials can become."""
+        if mesh is None:
+            return
+        from katib_tpu.parallel.mesh import trial_axis_size
+
+        if trial_axis_size(mesh) > 1 and spec.train_fn is None:
+            raise ValueError(
+                "mesh carries a trial axis of size "
+                f"{trial_axis_size(mesh)}, but the experiment runs black-box "
+                "command trials — the trial axis shards white-box cohort "
+                "members only (drop the axis or use a train_fn)"
+            )
+
+    #: implicit cohort key stamped when a trial-axis mesh is configured but
+    #: neither the proposals nor the spec name one — the slice should fill
+    #: without every caller re-declaring the obvious
+    _TRIAL_MESH_KEY = "trial-mesh"
+
+    def _group_proposals(
+        self, spec: ExperimentSpec, proposals: list, mesh=None
+    ) -> list[list]:
         """Partition a batch of proposals into cohort groups (each submitted
         as ONE vmap-batched program, ``runner/cohort.py``).
 
-        Grouping needs ``cohort_width > 1`` AND a train_fn with a declared
-        cohort twin.  Compatibility key: the per-proposal
+        Grouping needs an effective cohort width > 1 AND a train_fn with a
+        declared cohort twin.  The width is ``spec.cohort_width`` raised to
+        the mesh's trial-axis size when one is configured — a v5e-8 with a
+        ``{trial: 8}`` mesh fills all 8 chips per cohort even when the spec
+        says ``cohortWidth: 1``, so Hyperband/random sweeps saturate the
+        slice without spec changes.  Compatibility key: the per-proposal
         ``katib-tpu/cohort-key`` label (suggesters stamp it when members
         must share a compiled program), falling back to the spec-wide
-        ``cohort_key``; keyless proposals stay singletons.  The key is
-        stamped back into the proposal labels so the journal/UI show which
-        cohort a trial rode in."""
-        if spec.cohort_width <= 1 or cohort_fn_of(spec.train_fn) is None:
+        ``cohort_key`` and, on a trial-axis mesh, an implicit key (members
+        that disagree structurally still settle correctly via the runtime
+        ``shared()`` check + serial fallback, just slower — group
+        heterogeneous sweeps under explicit keys).  Keyless proposals stay
+        singletons.  The key is stamped back into the proposal labels so
+        the journal/UI show which cohort a trial rode in."""
+        trial_devices = 1
+        if mesh is not None:
+            from katib_tpu.parallel.mesh import trial_axis_size
+
+            trial_devices = trial_axis_size(mesh)
+        width = max(spec.cohort_width, trial_devices)
+        if width <= 1 or cohort_fn_of(spec.train_fn) is None:
             return [[p] for p in proposals]
+        default_key = spec.cohort_key or (
+            self._TRIAL_MESH_KEY if trial_devices > 1 else None
+        )
         groups: list[list] = []
         buckets: dict[str, list] = {}
         for p in proposals:
-            key = p.labels.get(_COHORT_KEY_LABEL) or spec.cohort_key
+            key = p.labels.get(_COHORT_KEY_LABEL) or default_key
             if not key:
                 groups.append([p])
                 continue
             p.labels.setdefault(_COHORT_KEY_LABEL, key)
             buckets.setdefault(key, []).append(p)
         for bucket in buckets.values():
-            for i in range(0, len(bucket), spec.cohort_width):
-                groups.append(bucket[i : i + spec.cohort_width])
+            for i in range(0, len(bucket), width):
+                groups.append(bucket[i : i + width])
         return groups
 
     def _execute_cohort(self, exp: Experiment, trials: list[Trial], mesh):

@@ -11,6 +11,11 @@ shard without API changes):
 - ``data``    — batch dimension (DP); gradients all-reduce over ICI
 - ``model``   — tensor parallelism (TP) for wide layers
 - ``seq``     — sequence/context parallelism (ring attention / Ulysses)
+- ``trial``   — the cohort member axis: a vmap-batched ``[K, ...]`` trial
+  cohort (``parallel/train.py:make_cohort_train_step``) shards its leading
+  member dimension over this axis, so D chips each step K/D members of one
+  SPMD program with no inter-chip collectives except the ``[K]`` metric
+  gather (the Podracer recipe: many independent learners, one program)
 
 A mesh with size-1 axes compiles to exactly the same XLA program as an
 unsharded one, so single-chip trials use the same code path as v5e-64 runs.
@@ -28,6 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
+TRIAL_AXIS = "trial"
 
 
 def make_mesh(
@@ -90,6 +96,51 @@ def replicate(tree, mesh: Mesh):
 
 def local_mesh_size(mesh: Mesh, axis: str = DATA_AXIS) -> int:
     return mesh.shape[axis] if axis in mesh.shape else 1
+
+
+# -- trial-parallel cohorts ---------------------------------------------------
+
+
+def trial_axis_size(mesh: Mesh | None) -> int:
+    """Devices on the cohort member axis (1 when absent / no mesh)."""
+    if mesh is None:
+        return 1
+    return mesh.shape[TRIAL_AXIS] if TRIAL_AXIS in mesh.shape else 1
+
+
+def trial_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for a stacked ``[K, ...]`` cohort pytree: leading member
+    dimension split over ``trial``, everything else replicated."""
+    return NamedSharding(mesh, PartitionSpec(TRIAL_AXIS))
+
+
+def padded_cohort_size(k: int, mesh: Mesh | None) -> int:
+    """``k`` rounded up to a multiple of the trial-axis size so every device
+    carries the same member count (callers pad with inert ghost members)."""
+    t = trial_axis_size(mesh)
+    return -(-k // t) * t
+
+
+def shard_members(tree, mesh: Mesh):
+    """Place a stacked ``[K, ...]`` cohort pytree with its member axis split
+    over ``trial`` (K must be a multiple of the trial-axis size — see
+    :func:`padded_cohort_size`)."""
+    sharding = trial_sharding(mesh)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)
+
+
+def serial_mesh(mesh: Mesh | None) -> Mesh | None:
+    """The mesh a SINGLETON trial should train on.  The ``trial`` axis
+    partitions cohort members, not tensors — a trial-axis-only mesh has no
+    data axis for ``shard_batch`` to split over, so serial paths (cohort
+    fallback, transient-member rejoin, plain ``run_trial``) drop to the
+    default single-device layout.  A mesh that also carries tensor axes is
+    returned unchanged (the singleton replicates over ``trial`` too)."""
+    if mesh is None:
+        return None
+    if set(mesh.shape) == {TRIAL_AXIS}:
+        return None
+    return mesh
 
 
 def needs_safe_conv(mesh: Mesh | None) -> bool:

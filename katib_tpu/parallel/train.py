@@ -17,7 +17,12 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from katib_tpu.parallel.mesh import DATA_AXIS, replicated
+from katib_tpu.parallel.mesh import (
+    DATA_AXIS,
+    replicated,
+    trial_axis_size,
+    trial_sharding,
+)
 
 
 class TrainState(NamedTuple):
@@ -122,6 +127,7 @@ def make_cohort_train_step(
     tx: optax.GradientTransformation,
     donate: bool = True,
     grad_clip_norm: float | None = None,
+    mesh: Mesh | None = None,
 ) -> Callable:
     """Build ``step(states, batch) -> (states, metrics)`` over a whole cohort.
 
@@ -131,6 +137,14 @@ def make_cohort_train_step(
     (``optax.inject_hyperparams``), so the K members — and every later
     cohort of the same shapes — share this single compiled executable; the
     carried state is donated so the device buffers are reused in place.
+
+    With a ``mesh`` carrying a ``trial`` axis of size D, the stacked member
+    dimension is split over it (batch replicated): D devices each step K/D
+    members of ONE SPMD program, with no inter-chip collectives except the
+    ``[K]`` metric gather at the host.  K must be a multiple of D
+    (``padded_cohort_size``); donation and the per-member non-finite freeze
+    are unchanged.  A mesh without a trial axis (or size 1) compiles the
+    same program as no mesh at all.
 
     Divergence is contained per member: a row whose loss goes non-finite
     keeps its previous state (its metrics stay non-finite from then on), so
@@ -165,13 +179,36 @@ def make_cohort_train_step(
 
         return jax.tree_util.tree_map(pick, new_states, states), metrics
 
-    return jax.jit(step, donate_argnums=(0,) if donate else ())
+    if mesh is None or trial_axis_size(mesh) <= 1:
+        return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+    member_sharding = trial_sharding(mesh)
+    shared_sharding = replicated(mesh)
+    return jax.jit(
+        step,
+        in_shardings=(member_sharding, shared_sharding),
+        out_shardings=(member_sharding, member_sharding),
+        donate_argnums=(0,) if donate else (),
+    )
 
 
-def make_cohort_eval_step(metric_fn: Callable[..., dict]) -> Callable:
+def make_cohort_eval_step(
+    metric_fn: Callable[..., dict],
+    mesh: Mesh | None = None,
+) -> Callable:
     """Build ``eval(params, batch) -> metrics`` vmapped over stacked
-    ``[K, ...]`` params with a shared batch; each returned metric is ``[K]``."""
-    return jax.jit(jax.vmap(metric_fn, in_axes=(0, None)))
+    ``[K, ...]`` params with a shared batch; each returned metric is ``[K]``.
+    With a trial-axis ``mesh`` the member dimension shards like the train
+    step's (params split over ``trial``, batch replicated)."""
+    veval = jax.vmap(metric_fn, in_axes=(0, None))
+    if mesh is None or trial_axis_size(mesh) <= 1:
+        return jax.jit(veval)
+    member_sharding = trial_sharding(mesh)
+    return jax.jit(
+        veval,
+        in_shardings=(member_sharding, replicated(mesh)),
+        out_shardings=member_sharding,
+    )
 
 
 def make_eval_step(

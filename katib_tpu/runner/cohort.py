@@ -82,6 +82,15 @@ class CohortContext:
         self.labels_list = [dict(t.spec.labels) for t in self.members]
         self.checkpoint_dirs = [t.checkpoint_dir for t in self.members]
         self.mesh = mesh
+        # devices on the mesh's reserved `trial` axis: the stacked member
+        # dimension shards over them, so K is padded up to a multiple with
+        # inert ghost members whose metric rows never reach the store
+        if mesh is not None:
+            from katib_tpu.parallel.mesh import trial_axis_size
+
+            self.trial_devices = trial_axis_size(mesh)
+        else:
+            self.trial_devices = 1
         self._store = store
         self._objective = objective
         self._stop_event = stop_event
@@ -107,13 +116,52 @@ class CohortContext:
     def __len__(self) -> int:
         return len(self.members)
 
+    @property
+    def padded_size(self) -> int:
+        """K rounded up to a multiple of the trial-axis size — the leading
+        dimension the stacked state pytree must carry on a sharded mesh.
+        Rows ``[K:]`` are ghost members: they train (on member 0's
+        hyperparameters, so they stay finite) but their metric rows are
+        dropped by ``report`` before the ObservationStore."""
+        t = self.trial_devices
+        return -(-len(self.members) // t) * t
+
+    @property
+    def cohort_mesh(self):
+        """The mesh the cohort step should shard over, or None when the
+        experiment mesh carries no trial axis (single-device vmap)."""
+        return self.mesh if self.trial_devices > 1 else None
+
     def stacked(self, name: str, default: Any = None, dtype=None):
-        """Per-member values of parameter ``name`` as a ``[K]`` jnp array —
-        the dynamic operand that rides inside the vmapped program."""
+        """Per-member values of parameter ``name`` as a ``[padded_size]``
+        jnp array — the dynamic operand that rides inside the vmapped
+        program.  Ghost rows repeat member 0's value (inert but finite)."""
         import jax.numpy as jnp
 
         vals = [p.get(name, default) for p in self.params_list]
+        vals += [vals[0]] * (self.padded_size - len(vals))
         return jnp.asarray(vals, dtype=dtype)
+
+    def place_members(self, tree):
+        """Device-put a stacked ``[padded_size, ...]`` pytree onto the
+        trial-sharded layout (identity without a trial axis, so cohort fns
+        call it unconditionally)."""
+        if self.trial_devices <= 1:
+            return tree
+        from katib_tpu.parallel.mesh import shard_members
+
+        return shard_members(tree, self.mesh)
+
+    def place_shared(self, tree):
+        """Device-put member-shared arrays (batches, eval sets) — replicated
+        across the mesh, or the default single-device placement without one."""
+        import jax
+
+        if self.trial_devices <= 1:
+            return jax.device_put(tree)
+        from katib_tpu.parallel.mesh import replicate
+
+        return replicate(tree, self.mesh)
 
     def shared(self, name: str, default: Any = None) -> Any:
         """A parameter every member must agree on (model shape, batch size —
@@ -149,6 +197,10 @@ class CohortContext:
             arr = np.asarray(value, dtype=float).reshape(-1)
             if arr.size == 1:
                 arr = np.full(k, arr[0])
+            if arr.size == self.padded_size and self.padded_size != k:
+                # ghost-member rows (sharded-mesh padding) are dropped
+                # before they can reach the store
+                arr = arr[:k]
             if arr.size != k:
                 raise ValueError(
                     f"metric {name!r} has {arr.size} rows for a {k}-member cohort"
@@ -281,9 +333,16 @@ def run_cohort(
     k = len(survivors)
     key = survivors[0].spec.labels.get(COHORT_KEY_LABEL, "")
     ctx = CohortContext(survivors, store, objective, mesh=mesh, stop_event=stop_event)
+    devices = ctx.trial_devices
     started = time.perf_counter()
     try:
-        with tracing.span("cohort", size=k, key=key):
+        with tracing.span(
+            "cohort",
+            size=k,
+            key=key,
+            devices=devices,
+            members_per_device=ctx.padded_size // devices,
+        ):
             cohort_fn(ctx)
     except Exception:
         # the vectorized path is an optimization, never a correctness
@@ -298,6 +357,7 @@ def run_cohort(
     obs.cohorts_executed.inc()
     obs.cohort_size.observe(float(k))
     obs.cohort_trials_per_sec.set(k / elapsed)
+    obs.cohort_devices.set(float(devices))
     per_member = elapsed / k
     for i, t in enumerate(survivors):
         results[t.name] = ctx._settle(i)

@@ -125,6 +125,13 @@ def run_trial(
     ``injector`` (a ``faults.FaultInjector``) is the chaos seam: it fires
     inside this classification try-block, so injected faults take exactly
     the path a real preemption or shape error would."""
+    if mesh is not None:
+        # a trial-axis-only mesh partitions cohort MEMBERS, not tensors: a
+        # singleton (cohort fallback, transient-member rejoin) has no data
+        # axis to shard over, so it trains on the default device layout
+        from katib_tpu.parallel.mesh import serial_mesh
+
+        mesh = serial_mesh(mesh)
     evaluator = RuleEvaluator(trial.spec.early_stopping_rules, objective)
     try:
         if injector is not None:

@@ -426,6 +426,11 @@ cohort_fallbacks = REGISTRY.counter(
     "katib_cohort_fallback_total",
     "Cohorts whose vectorized path failed and re-ran members serially",
 )
+cohort_devices = REGISTRY.gauge(
+    "katib_cohort_devices",
+    "Devices the most recent cohort's trial axis spanned "
+    "(1 = single-device vmap, D = SPMD-sharded member dimension)",
+)
 compile_cache_enabled = REGISTRY.gauge(
     "katib_compile_cache_enabled",
     "1 when the persistent XLA compilation cache is wired "

@@ -76,6 +76,23 @@ def aot_block_for(batch: int, policy: str | None, pairhess: bool = False) -> dic
         return None
 
 
+def _flush(points: list[dict]) -> dict:
+    """Rewrite batch_scaling.json with the points measured SO FAR.  Called
+    after every point: the outer window driver (scripts/tpu_window5c.sh)
+    hard-kills this script's process group at its step timeout, and an
+    end-only write would lose every already-measured chip point with it."""
+    result = {
+        "what": (
+            "flagship second-order bilevel step throughput vs batch size; "
+            "each point measured by bench.py's fetch-forced child on the "
+            "chip, submitted only with committed AOT HBM-fit proof"
+        ),
+        "points": points,
+    }
+    write_artifact("flagship", "batch_scaling.json", result)
+    return result
+
+
 def main() -> int:
     configs = parse_configs(os.environ.get("SCALING_CONFIGS", "64:none,128:dots"))
     steps = os.environ.get("BENCH_STEPS", "5")
@@ -103,6 +120,7 @@ def main() -> int:
                     ),
                 }
             )
+            _flush(points)
             continue
         env = dict(os.environ)
         env.update(
@@ -150,6 +168,7 @@ def main() -> int:
                     "timeout": True,
                 }
             )
+            _flush(points)
             continue
         rec: dict | None = None
         for line in (proc.stdout or "").splitlines():
@@ -165,6 +184,7 @@ def main() -> int:
                     "stderr_tail": (proc.stderr or "")[-500:],
                 }
             )
+            _flush(points)
             continue
         points.append(
             {
@@ -178,17 +198,10 @@ def main() -> int:
                 "aot_hbm_gib": aot["hbm_gib"],
             }
         )
+        _flush(points)
         print(f"scaling:   -> {rec['value']} img/s ({rec['step_secs']}s/step)", flush=True)
 
-    result = {
-        "what": (
-            "flagship second-order bilevel step throughput vs batch size; "
-            "each point measured by bench.py's fetch-forced child on the "
-            "chip, submitted only with committed AOT HBM-fit proof"
-        ),
-        "points": points,
-    }
-    write_artifact("flagship", "batch_scaling.json", result)
+    result = _flush(points)
     print(json.dumps(result["points"]), flush=True)
     ok = any("images_per_sec" in p for p in points)
     return 0 if ok else 1

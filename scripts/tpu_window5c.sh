@@ -40,20 +40,31 @@ probe || exit 1
 
 # 1. flagship at 50 epochs in step-loop mode.  Per-epoch Orbax snapshots +
 #    watchdog exit-75 keep mid-run stalls resume-safe; loop attempts.
+#    flagship_ok records whether ANY attempt completed: the augment step
+#    below consumes the genotype this search writes, and augmenting a
+#    stale/absent genotype silently reports the wrong round's architecture.
+flagship_ok=0
 for attempt in 1 2 3; do
     run 9000 flagship_steploop_$attempt env KATIB_STEP_LOOP=1 \
         FLAGSHIP_EPOCHS=50 FLAGSHIP_BATCH=64 FLAGSHIP_REMAT=0 \
         FLAGSHIP_FUSED=0 python scripts/run_flagship_tpu.py
     rc=$?
-    [ "$rc" -eq 0 ] && break
+    if [ "$rc" -eq 0 ]; then flagship_ok=1; break; fi
     echo "=== flagship attempt $attempt rc=$rc — reprobing" >>"$LOG/driver.log"
     probe || exit 1
 done
 
 probe || exit 1
 
-# 2. augment the discovered genotype: accuracy-vs-epoch + honest timing
-run 5400 augment_genotype env AUGMENT_EPOCHS=20 python scripts/run_augment_tpu.py
+# 2. augment the discovered genotype: accuracy-vs-epoch + honest timing.
+#    Genotype-dependent: skipped (and marked in the driver log) when no
+#    flagship attempt succeeded this round — there is no fresh genotype.
+if [ "$flagship_ok" -eq 1 ]; then
+    run 5400 augment_genotype env AUGMENT_EPOCHS=20 python scripts/run_augment_tpu.py
+else
+    echo "=== augment_genotype SKIPPED: no flagship attempt succeeded this round" \
+        | tee -a "$LOG/driver.log"
+fi
 
 probe || exit 1
 
