@@ -51,6 +51,40 @@ def _table(rows: list[list[str]], header: list[str]) -> str:
     return "\n".join(lines)
 
 
+def _install_drain_handlers(orch) -> None:
+    """SIGTERM/SIGINT → graceful drain; a second signal escalates to a hard
+    stop (running trials are killed at the next boundary instead of being
+    given the drain grace window).  Mirrors kubelet pod termination: TERM
+    first, impatience escalates."""
+    import signal
+
+    seen = {"count": 0}
+
+    def _on_signal(signum, frame):  # noqa: ARG001 - signal handler shape
+        seen["count"] += 1
+        if seen["count"] == 1:
+            print(
+                f"received {signal.Signals(signum).name}: draining "
+                "(checkpoint running trials, flush journal; signal again to "
+                "stop immediately)",
+                file=sys.stderr,
+            )
+            orch.drain()
+        else:
+            print(
+                f"received {signal.Signals(signum).name} again: stopping now",
+                file=sys.stderr,
+            )
+            orch.stop()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, _on_signal)
+        except (ValueError, OSError):
+            # not the main thread (embedded use) — drain stays API-only
+            return
+
+
 def cmd_run(args: argparse.Namespace) -> int:
     from katib_tpu.sdk.yaml_spec import load_experiment_yaml
 
@@ -72,6 +106,13 @@ def cmd_run(args: argparse.Namespace) -> int:
 
     init_compile_cache(spec.compile_cache)
     orch = cfg.make_orchestrator()
+    # CLI runs own the process, so a drain that leaves wedged trial threads
+    # behind may hard-exit with the resumable code after journaling
+    # (library callers keep the default cooperative wind-down instead)
+    orch.drain_hard_exit = True
+    if args.drain_grace_seconds is not None:
+        spec.drain_grace_seconds = args.drain_grace_seconds
+    _install_drain_handlers(orch)
     if args.resume:
         existing = orch.load_experiment(spec)
         if existing is None:
@@ -88,6 +129,18 @@ def cmd_run(args: argparse.Namespace) -> int:
             return 2
     else:
         exp = orch.run(spec)
+    if orch.drained:
+        # resumable preemption exit: SIGTERM arrived, running trials were
+        # checkpointed (or journaled Drained), the journal + suggester state
+        # were flushed — rerun with --resume to continue where this left off
+        print(
+            f"experiment {exp.name}: drained ({exp.message}); "
+            f"rerun with --resume to continue",
+            file=sys.stderr,
+        )
+        from katib_tpu.orchestrator.orchestrator import DRAIN_EXIT_CODE
+
+        return DRAIN_EXIT_CODE
     status = "ok" if exp.condition.value != "Failed" else "FAILED"
     print(f"experiment {exp.name}: {exp.condition.value} ({exp.message}) [{status}]")
     if exp.optimal is not None:
@@ -359,6 +412,7 @@ def cmd_chaos(args: argparse.Namespace) -> int:
         ObjectiveType,
         ParameterSpec,
         ParameterType,
+        ResumePolicy,
         TrialCondition,
     )
     from katib_tpu.orchestrator import Orchestrator
@@ -375,9 +429,24 @@ def cmd_chaos(args: argparse.Namespace) -> int:
         injector.fail_trial(int(parts[0]), int(parts[1]), kind)
     for call in args.fail_suggester or []:
         injector.fail_suggester(int(call))
+    for spec_str in args.hang_trial or []:
+        parts = spec_str.split(":")
+        if len(parts) not in (1, 2):
+            print(f"bad --hang-trial {spec_str!r} (want K[:J])", file=sys.stderr)
+            return 2
+        injector.hang_trial(int(parts[0]), int(parts[1]) if len(parts) == 2 else 1)
+    if args.preempt_at is not None:
+        injector.preempt_at(args.preempt_at)
     if args.flake_rate:
         injector.flake(args.flake_rate)
-    if not injector.log and not (args.fail_trial or args.fail_suggester or args.flake_rate):
+    injected_any = (
+        args.fail_trial
+        or args.fail_suggester
+        or args.flake_rate
+        or args.hang_trial
+        or args.preempt_at is not None
+    )
+    if not injector.log and not injected_any:
         # default scenario: first trial is preempted twice, one suggester
         # call blows up — the experiment must shrug all of it off
         injector.fail_trial(0, 1).fail_trial(0, 2).fail_suggester(2)
@@ -412,12 +481,57 @@ def cmd_chaos(args: argparse.Namespace) -> int:
         max_retries=args.max_retries,
         retry_backoff_seconds=0.05,
         suggester_max_errors=args.suggester_max_errors,
+        # hang watchdog only arms when a deadline is set; keep it off unless
+        # the scenario injects hangs so the happy path stays unchanged
+        progress_deadline_seconds=(
+            args.progress_deadline if args.hang_trial else None
+        ),
+        drain_grace_seconds=args.drain_grace,
+        # the preempt scenario spans two orchestrator lifetimes; a resumable
+        # policy upgrades the store to the durable sqlite backend so metrics
+        # reported before the SIGTERM survive into the resumed process
+        resume_policy=(
+            ResumePolicy.LONG_RUNNING
+            if args.preempt_at is not None
+            else ResumePolicy.NEVER
+        ),
         train_fn=trainer,
     )
     errors_before = obs.suggester_errors.get(algorithm="random")
     retried_before = obs.trials_retried.get(kind=FailureKind.TRANSIENT.value)
+    hangs_before = obs.trial_hangs.get()
+    preempted = False
+    completed_at_drain: set[str] = set()
     with tempfile.TemporaryDirectory(prefix="katib-chaos-") as workdir:
-        exp = Orchestrator(workdir=workdir, fault_injector=injector).run(spec)
+        orch = Orchestrator(workdir=workdir, fault_injector=injector)
+        if args.preempt_at is not None:
+            # the injected preempt delivers a real SIGTERM to this process:
+            # install the same drain handlers `katib-tpu run` uses so the
+            # orchestrator checkpoints, journals, and returns resumable state
+            _install_drain_handlers(orch)
+        exp = orch.run(spec)
+        if orch.drained:
+            preempted = True
+            completed_at_drain = {
+                t.name
+                for t in exp.trials.values()
+                if t.condition is TrialCondition.SUCCEEDED
+            }
+            drained_names = [
+                t.name
+                for t in exp.trials.values()
+                if t.condition is TrialCondition.DRAINED
+            ]
+            print(
+                f"preempted mid-experiment: {len(completed_at_drain)} trial(s) "
+                f"completed, {len(drained_names)} drained "
+                f"({', '.join(drained_names) or 'none'}); resuming from journal"
+            )
+            # fresh orchestrator = new process semantics: everything it knows
+            # must come from the journal + suggester pickle, not live memory
+            orch = Orchestrator(workdir=workdir, fault_injector=injector)
+            _install_drain_handlers(orch)
+            exp = orch.run(spec, experiment=orch.load_experiment(spec))
 
     print(f"chaos seed={args.seed}  experiment={exp.condition.value}")
     for t in sorted(exp.trials.values(), key=lambda t: t.start_time):
@@ -428,10 +542,53 @@ def cmd_chaos(args: argparse.Namespace) -> int:
     print(
         f"injected: {len(injector.log)} faults; "
         f"retries={obs.trials_retried.get(kind=FailureKind.TRANSIENT.value) - retried_before:g}; "
-        f"suggester errors absorbed={obs.suggester_errors.get(algorithm='random') - errors_before:g}"
+        f"suggester errors absorbed={obs.suggester_errors.get(algorithm='random') - errors_before:g}; "
+        f"hangs caught={obs.trial_hangs.get() - hangs_before:g}"
     )
 
     failures = []
+    if args.hang_trial:
+        hung = [
+            t
+            for t in exp.trials.values()
+            if t.failure_kind == FailureKind.HANG.value and t.retry_count > 0
+        ]
+        if obs.trial_hangs.get() - hangs_before <= 0:
+            failures.append("injected hang was never caught by the watchdog")
+        elif not hung:
+            failures.append(
+                "no trial journaled failure_kind=Hang with a retry "
+                "(watchdog fired but retry machinery did not reclassify)"
+            )
+        elif not all(t.condition is TrialCondition.SUCCEEDED for t in hung):
+            failures.append(
+                "hung trial did not recover on retry: "
+                f"{[(t.name, t.condition.value) for t in hung]}"
+            )
+    if args.preempt_at is not None:
+        if not preempted:
+            failures.append(
+                "injected preemption did not drain the orchestrator "
+                "(SIGTERM handler or drain path broken)"
+            )
+        else:
+            still_completed = {
+                t.name
+                for t in exp.trials.values()
+                if t.condition is TrialCondition.SUCCEEDED
+            }
+            lost = completed_at_drain - still_completed
+            if lost:
+                failures.append(
+                    f"completed trials lost across the drain/resume cycle: {sorted(lost)}"
+                )
+            leftover = [
+                t.name
+                for t in exp.trials.values()
+                if t.condition is TrialCondition.DRAINED
+            ]
+            if leftover:
+                failures.append(f"drained trials never resubmitted: {leftover}")
     if not exp.condition.is_terminal():
         failures.append(f"experiment not terminal: {exp.condition.value}")
     if exp.condition is ExperimentCondition.FAILED:
@@ -729,6 +886,14 @@ def main(argv: list[str] | None = None) -> int:
         action="store_true",
         help="resume from the status journal (honors spec resumePolicy)",
     )
+    p.add_argument(
+        "--drain-grace-seconds",
+        type=float,
+        default=None,
+        help="on SIGTERM/SIGINT, wait this long for running trials to reach "
+        "a checkpoint boundary before journaling them Drained "
+        "(overrides the spec's drainGraceSeconds)",
+    )
     p.set_defaults(fn=cmd_run)
 
     p = sub.add_parser("list", help="list experiments")
@@ -806,6 +971,33 @@ def main(argv: list[str] | None = None) -> int:
         type=float,
         default=0.0,
         help="seeded random per-attempt transient failure probability",
+    )
+    p.add_argument(
+        "--hang-trial",
+        action="append",
+        metavar="K[:J]",
+        help="wedge trial K's attempt J (default 1) until the hang watchdog "
+        "interrupts it; repeatable",
+    )
+    p.add_argument(
+        "--preempt-at",
+        type=int,
+        default=None,
+        metavar="N",
+        help="deliver a real SIGTERM to this process when trial N starts "
+        "(drain -> journal -> in-process resume, asserting zero lost trials)",
+    )
+    p.add_argument(
+        "--progress-deadline",
+        type=float,
+        default=0.75,
+        help="progressDeadlineSeconds used when --hang-trial is given",
+    )
+    p.add_argument(
+        "--drain-grace",
+        type=float,
+        default=5.0,
+        help="drainGraceSeconds for the chaos experiment",
     )
     p.set_defaults(fn=cmd_chaos)
 

@@ -439,6 +439,11 @@ class TrialCondition(str, enum.Enum):
     FAILED = "Failed"
     EARLY_STOPPED = "EarlyStopped"
     METRICS_UNAVAILABLE = "MetricsUnavailable"
+    # checkpoint-and-exit during an orchestrator drain (preemption SIGTERM):
+    # deliberately NON-terminal — a resumed run resubmits the trial under the
+    # same name/checkpoint dir and it continues from its last saved step, and
+    # the max_trial_count budget is never charged for a preempted slot
+    DRAINED = "Drained"
 
     def is_terminal(self) -> bool:
         return self in (
@@ -543,6 +548,13 @@ class TrialSpec:
     # first-retry delay for the shared exponential backoff (doubles per
     # attempt, jittered, capped at ~30s, stop-event responsive)
     retry_backoff_seconds: float = 1.0
+    # hang watchdog: fail the trial FailureKind.HANG when no progress
+    # (report() call / cohort step / black-box metric activity) lands for
+    # this long (utils/watchdog.py).  Unlike max_runtime_seconds — which is
+    # only polled at reporting points for white-box trials — the watchdog's
+    # monitor thread interrupts a train_fn wedged BETWEEN reports (stuck
+    # compile, deadlocked collective).  None = disabled.
+    progress_deadline_seconds: float | None = None
 
     def params(self) -> dict[str, Any]:
         return assignments_to_dict(self.assignments)
@@ -662,6 +674,14 @@ class ExperimentSpec:
     # (jax_compilation_cache_dir); None falls back to the
     # KATIB_COMPILE_CACHE env var, empty/unset disables.
     compile_cache: str | None = None
+    # Hang watchdog: classify a trial FailureKind.HANG (and interrupt it)
+    # when no progress signal lands for this long — propagated into every
+    # TrialSpec (see TrialSpec.progress_deadline_seconds).  None = disabled.
+    progress_deadline_seconds: float | None = None
+    # Graceful-drain window after SIGTERM/SIGINT on `katib-tpu run`: running
+    # trials get this long to checkpoint-and-exit at a step boundary before
+    # being hard-killed (still journaled Drained, so resume re-runs them).
+    drain_grace_seconds: float = 30.0
 
     def parameter(self, name: str) -> ParameterSpec:
         for p in self.parameters:

@@ -719,9 +719,11 @@ def darts_trial(ctx) -> None:
     # ``augment_epochs`` > 0 turns it on; the reference has no equivalent —
     # its trial ends at the printed genotype)
     aug_epochs = int(settings.get("augment_epochs", 0))
-    if aug_epochs > 0 and not stopped[0]:
+    if aug_epochs > 0 and not stopped[0] and not ctx.should_stop():
         # an early-stopped search must not burn an augment budget the
-        # orchestrator already decided to reclaim
+        # orchestrator already decided to reclaim; likewise a drain signal
+        # landing between the last search epoch and this phase boundary —
+        # the genotype is already persisted, so exiting here loses nothing
         from katib_tpu.nas.darts.augment import train_genotype
 
         acc = train_genotype(

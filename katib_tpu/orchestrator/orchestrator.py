@@ -55,6 +55,12 @@ from katib_tpu.suggest.base import call_suggester, make_suggester
 from katib_tpu.utils import faults
 from katib_tpu.utils import observability as obs
 from katib_tpu.utils import tracing
+from katib_tpu.utils.watchdog import Watchdog
+
+#: process exit code `katib-tpu run` returns after a graceful drain —
+#: EX_TEMPFAIL (75), already in faults.RETRYABLE_EXIT_CODES, so a supervisor
+#: (or a katib-tpu black-box parent!) reads it as "re-run me with --resume"
+DRAIN_EXIT_CODE = 75
 
 
 class Orchestrator:
@@ -96,6 +102,22 @@ class Orchestrator:
         # own wind-down event for in-flight trials
         self._stop_requested = threading.Event()
         self._stop_event = threading.Event()
+        # graceful-drain request (preemption SIGTERM/SIGINT on the CLI):
+        # sticky like stop; the per-run _drain_event asks in-flight trials to
+        # checkpoint-and-exit at their next step boundary
+        self._drain_requested = threading.Event()
+        self._drain_event = threading.Event()
+        #: True after run() returned via a drain — the CLI maps this to
+        #: DRAIN_EXIT_CODE so supervisors re-launch with --resume
+        self.drained = False
+        #: set by the CLI only: after the grace window, stragglers that
+        #: cannot be joined must not block process exit — journal, then
+        #: os._exit(DRAIN_EXIT_CODE).  Library callers keep the default
+        #: (False): cooperative stragglers are joined on pool shutdown.
+        self.drain_hard_exit = False
+        # hang watchdog shared by every trial of a run (monitor thread
+        # starts lazily on the first progress_deadline_seconds trial)
+        self._watchdog: Watchdog | None = None
         # trials whose checkpoint dir belongs to the suggester (PBT lineage)
         # — exempt from retain-cleanup
         self._suggester_owned_ckpts: set[str] = set()
@@ -111,6 +133,17 @@ class Orchestrator:
         stopped orchestrator will not run further experiments."""
         self._stop_requested.set()
         self._stop_event.set()
+
+    def drain(self) -> None:
+        """Request a graceful drain (preemption semantics): stop proposing,
+        ask running trials/cohorts to checkpoint-and-exit at their next step
+        boundary, flush journal + suggester state, and return with the
+        experiment still non-terminal so ``--resume`` continues it.  Bounded
+        by ``ExperimentSpec.drain_grace_seconds``; see :data:`DRAIN_EXIT_CODE`.
+        A second signal should call :meth:`stop` instead (abandon drain)."""
+        self._drain_requested.set()
+        self._drain_event.set()
+        obs.drain_requested.set(1)
 
     # -- public API ---------------------------------------------------------
 
@@ -218,6 +251,16 @@ class Orchestrator:
         self._stop_event = stop_event
         if self._stop_requested.is_set():
             stop_event.set()
+        # fresh per-run drain event (a resumed run must not inherit the
+        # previous process's drain); the sticky request flag is honored on
+        # the first loop iteration
+        drain_event = threading.Event()
+        self._drain_event = drain_event
+        if self._drain_requested.is_set():
+            drain_event.set()
+        self.drained = False
+        obs.drain_requested.set(1.0 if self._drain_requested.is_set() else 0.0)
+        self._watchdog = Watchdog()
 
         # a bad mesh config must still settle the experiments_current gauge
         # and the status journal before surfacing
@@ -264,6 +307,13 @@ class Orchestrator:
                     exp.update_optimal()
                     self._finish(exp)
                     return exp
+                if self._drain_requested.is_set():
+                    # preemption drain: checkpoint-and-exit within the grace
+                    # window, journal everything, return NON-terminal so the
+                    # next process resumes from the checkpointed steps
+                    return self._drain_and_exit(
+                        exp, futures, suggester, stop_event, drain_event
+                    )
                 verdict = self._check_terminal(exp, exhausted, futures)
                 if verdict is not None:
                     stop_event.set()
@@ -385,6 +435,9 @@ class Orchestrator:
             self._finish(exp)
             raise
           finally:
+            watchdog, self._watchdog = self._watchdog, None
+            if watchdog is not None:
+                watchdog.stop()
             # final durable-state write so a completed-then-reopened
             # experiment (raised max_trial_count) resumes the suggester too
             self._persist_suggester(exp, suggester)
@@ -426,6 +479,7 @@ class Orchestrator:
                 metrics_retries=exp.spec.metrics_retries,
                 max_retries=exp.spec.max_retries,
                 retry_backoff_seconds=exp.spec.retry_backoff_seconds,
+                progress_deadline_seconds=exp.spec.progress_deadline_seconds,
             ),
             condition=TrialCondition.RUNNING,
             start_time=time.time(),
@@ -544,6 +598,8 @@ class Orchestrator:
                     mesh=mesh,
                     stop_event=self._stop_event,
                     injector=self.fault_injector,
+                    watchdog=self._watchdog,
+                    drain_event=self._drain_event,
                 )
             except Exception as e:  # defense: run_cohort itself never raises
                 results = {
@@ -565,13 +621,15 @@ class Orchestrator:
                     continue
                 if (
                     r.condition is TrialCondition.FAILED
-                    and r.failure_kind is faults.FailureKind.TRANSIENT
+                    and r.failure_kind is not None
+                    and r.failure_kind.retryable
                     and t.retry_count < t.spec.max_retries
                     and not self._stop_event.is_set()
+                    and not self._drain_event.is_set()
                 ):
                     t.retry_count += 1
-                    t.failure_kind = faults.FailureKind.TRANSIENT.value
-                    obs.trials_retried.inc(kind=faults.FailureKind.TRANSIENT.value)
+                    t.failure_kind = r.failure_kind.value
+                    obs.trials_retried.inc(kind=r.failure_kind.value)
                     self._publish(exp)
                     results[t.name] = self._execute(exp, t, mesh)
                 elif (
@@ -664,13 +722,15 @@ class Orchestrator:
         result = self._execute_on(exp, trial, mesh)
         while (
             result.condition is TrialCondition.FAILED
-            and result.failure_kind is faults.FailureKind.TRANSIENT
+            and result.failure_kind is not None
+            and result.failure_kind.retryable  # TRANSIENT and HANG re-run
             and trial.retry_count < trial.spec.max_retries
             and not self._stop_event.is_set()
+            and not self._drain_event.is_set()  # draining: journal, don't re-run
         ):
             trial.retry_count += 1
-            trial.failure_kind = faults.FailureKind.TRANSIENT.value
-            obs.trials_retried.inc(kind=faults.FailureKind.TRANSIENT.value)
+            trial.failure_kind = result.failure_kind.value
+            obs.trials_retried.inc(kind=result.failure_kind.value)
             # journal the spent retry before sleeping: a crash mid-backoff
             # must not reset the per-trial retry budget on resume
             self._publish(exp)
@@ -680,6 +740,8 @@ class Orchestrator:
             result = self._execute_on(exp, trial, mesh)
         for i in range(trial.spec.metrics_retries):
             if result.condition is not TrialCondition.METRICS_UNAVAILABLE:
+                break
+            if self._drain_event.is_set():
                 break
             if not backoff.wait(i + 1, self._stop_event):
                 break
@@ -700,6 +762,8 @@ class Orchestrator:
                         trial, self.store, exp.spec.objective,
                         mesh=mesh, stop_event=self._stop_event,
                         injector=self.fault_injector,
+                        watchdog=self._watchdog,
+                        drain_event=self._drain_event,
                     )
             except Exception as e:
                 return TrialResult(
@@ -716,6 +780,8 @@ class Orchestrator:
             mesh=mesh,
             stop_event=self._stop_event,
             injector=self.fault_injector,
+            watchdog=self._watchdog,
+            drain_event=self._drain_event,
         )
 
     def _finish(self, exp: Experiment) -> None:
@@ -745,6 +811,92 @@ class Orchestrator:
             tracing.deactivate(self._prev_tracer)
             tracer.close()
         self._publish(exp)
+
+    def _drain_and_exit(
+        self,
+        exp: Experiment,
+        futures: dict,
+        suggester,
+        stop_event: threading.Event,
+        drain_event: threading.Event,
+    ) -> Experiment:
+        """Graceful preemption wind-down (the run loop's drain branch).
+
+        Ordering is the whole point: (1) stop proposing and cancel queued
+        futures, (2) raise the drain flag every running trial/cohort observes
+        through its context, (3) wait out ``drain_grace_seconds`` harvesting
+        trials that checkpoint-and-exit (settled ``Drained``), (4) journal
+        stragglers as ``Drained`` anyway and set the stop event so their
+        threads wind down, (5) flush suggester state + status.json, record
+        the ``drain`` span, and return with the experiment NON-terminal —
+        the resumed process re-submits every Drained/Pending trial under its
+        original name and checkpoint dir.  With ``drain_hard_exit`` (the CLI)
+        a wedged straggler cannot block process exit: journal first, then
+        ``os._exit(DRAIN_EXIT_CODE)``."""
+        spec = exp.spec
+        grace = max(0.0, spec.drain_grace_seconds)
+        obs.drain_requested.set(1.0)
+        drain_start = self._tracer.elapsed() if self._tracer else 0.0
+        t0 = time.perf_counter()
+        self._cancel_pending(futures)
+        drain_event.set()
+        if futures:
+            cf.wait(list(futures), timeout=grace)
+        self._harvest(exp, futures, drain=True)
+        checkpointed = sum(
+            1 for t in exp.trials.values() if t.condition is TrialCondition.DRAINED
+        )
+        # stragglers: still running past the grace window — journal them
+        # Drained (resume re-runs them from their last voluntary checkpoint)
+        # and fire the stop event so their threads/subprocesses wind down
+        stragglers: list[Trial] = []
+        for f in list(futures):
+            owner = futures.pop(f)
+            members = owner if isinstance(owner, list) else [owner]
+            for trial in members:
+                trial.condition = TrialCondition.DRAINED
+                trial.message = (
+                    "preempted: no checkpoint boundary within "
+                    f"drain_grace_seconds={grace:g}; resuming from last checkpoint"
+                )
+                stragglers.append(trial)
+        stop_event.set()
+        exp.update_optimal()
+        self._persist_suggester(exp, suggester)
+        exp.message = (
+            f"drained after preemption signal ({checkpointed} trial(s) "
+            f"checkpointed, {len(stragglers)} killed at the grace window); "
+            "resumable with --resume"
+        )
+        self.drained = True
+        duration = time.perf_counter() - t0
+        obs.experiments_current.dec()
+        tracer, self._tracer = self._tracer, None
+        if tracer is not None:
+            tracer.record(
+                "drain",
+                drain_start,
+                duration,
+                checkpointed=checkpointed,
+                killed=len(stragglers),
+                grace=grace,
+            )
+            tracer.record(
+                "experiment",
+                self._exp_span_start,
+                tracer.elapsed() - self._exp_span_start,
+                algorithm=spec.algorithm.name,
+                condition="Drained",
+                trials=len(exp.trials),
+            )
+            tracing.deactivate(self._prev_tracer)
+            tracer.close()
+        self._publish(exp)
+        if stragglers and self.drain_hard_exit:
+            # a wedged train_fn cannot be joined; everything durable is
+            # flushed, so trade the stuck threads for a prompt resumable exit
+            os._exit(DRAIN_EXIT_CODE)
+        return exp
 
     @staticmethod
     def _observe_trial_duration(trial: Trial) -> None:
@@ -808,7 +960,11 @@ class Orchestrator:
             pass
 
     def _harvest(
-        self, exp: Experiment, futures: dict, wait_running: bool = False
+        self,
+        exp: Experiment,
+        futures: dict,
+        wait_running: bool = False,
+        drain: bool = False,
     ) -> None:
         done = [f for f in futures if f.done()]
         if wait_running and futures:
@@ -820,6 +976,12 @@ class Orchestrator:
             members = owner if isinstance(owner, list) else [owner]
             if f.cancelled():
                 for trial in members:
+                    if drain:
+                        # never started: back to PENDING so the resumed run
+                        # submits it fresh (no budget slot consumed)
+                        trial.condition = TrialCondition.PENDING
+                        trial.message = "drained before start; resubmitted on resume"
+                        continue
                     trial.condition = TrialCondition.KILLED
                     trial.completion_time = time.time()
                     obs.trials_killed.inc()
@@ -840,7 +1002,13 @@ class Orchestrator:
                 trial.condition = res.condition
                 trial.message = res.message
                 fk = getattr(res, "failure_kind", None)
-                trial.failure_kind = fk.value if fk is not None else None
+                if fk is not None:
+                    trial.failure_kind = fk.value
+                elif not trial.retry_count:
+                    # keep the last failure's classification on a recovered
+                    # retry (journal answers "what did this trial survive?");
+                    # clean first-attempt results clear any resumed leftover
+                    trial.failure_kind = None
                 trial.completion_time = time.time()
                 if trial.condition in (
                     TrialCondition.SUCCEEDED,
@@ -874,12 +1042,20 @@ class Orchestrator:
             or trial.condition is not TrialCondition.SUCCEEDED
         ):
             return
-        from katib_tpu.utils.checkpoint import TrialCheckpointer, _step_path
+        from katib_tpu.utils.checkpoint import (
+            TrialCheckpointer,
+            _manifest_path,
+            _step_path,
+        )
 
         try:
             ck = TrialCheckpointer(trial.checkpoint_dir, max_to_keep=0)
             for step in ck.all_steps():
                 shutil.rmtree(_step_path(trial.checkpoint_dir, step), ignore_errors=True)
+                try:
+                    os.unlink(_manifest_path(trial.checkpoint_dir, step))
+                except OSError:
+                    pass
         except (OSError, ValueError):
             pass
 

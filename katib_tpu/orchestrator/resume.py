@@ -105,8 +105,12 @@ def trial_from_dict(spec: ExperimentSpec, data: dict) -> Trial:
             metrics_retries=spec.metrics_retries,
             max_retries=spec.max_retries,
             retry_backoff_seconds=spec.retry_backoff_seconds,
+            progress_deadline_seconds=spec.progress_deadline_seconds,
         ),
-        # non-terminal journal entries become PENDING: run() resubmits them
+        # non-terminal journal entries become PENDING: run() resubmits them.
+        # Drained trials (preemption) land here by design: same name +
+        # checkpoint dir, so a checkpoint-aware train_fn continues from the
+        # step it saved during the drain window instead of step 0.
         condition=TrialCondition.PENDING if resubmit else condition,
         observation=_observation_from_list(data.get("observation")),
         message=data.get("message", "") if not resubmit else "resubmitted after restart",

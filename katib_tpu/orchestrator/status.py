@@ -64,6 +64,10 @@ def experiment_to_dict(exp: Experiment) -> dict:
             "early_stopped": exp.early_stopped_count,
             "metrics_unavailable": exp.metrics_unavailable_count,
             "running": exp.running_count,
+            # preemption drain: non-terminal, resubmitted on resume
+            "drained": sum(
+                1 for t in exp.trials.values() if t.condition.value == "Drained"
+            ),
             # total transient retries spent across all trials (surfaced in
             # the UI counter strip and `katib-tpu describe`)
             "retried": sum(t.retry_count for t in exp.trials.values()),

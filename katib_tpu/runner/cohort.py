@@ -76,6 +76,9 @@ class CohortContext:
         objective,
         mesh: Any = None,
         stop_event: threading.Event | None = None,
+        drain_event: threading.Event | None = None,
+        hang_event: threading.Event | None = None,
+        heartbeat: Any = None,
     ):
         self.members = list(members)
         self.params_list = [t.params() for t in self.members]
@@ -94,6 +97,12 @@ class CohortContext:
         self._store = store
         self._objective = objective
         self._stop_event = stop_event
+        # drain (orchestrator preemption) + hang-watchdog plumbing, same
+        # semantics as TrialContext: the whole cohort checkpoints-and-exits
+        # at its next step boundary / is classified hung as one program
+        self._drain_event = drain_event
+        self._hang_event = hang_event
+        self._heartbeat = heartbeat
         self._evaluators = [
             RuleEvaluator(t.spec.early_stopping_rules, objective)
             for t in self.members
@@ -186,6 +195,8 @@ class CohortContext:
         ("diverged" — the identical re-run would diverge again); non-finite
         values are never written to the store so reductions stay clean.
         """
+        if self._heartbeat is not None:
+            self._heartbeat()  # cohort step boundary = watchdog progress
         if step is None:
             step = self._step
             self._step += 1
@@ -254,10 +265,20 @@ class CohortContext:
             return True
         if self.deadline_exceeded():
             return True
+        if self.hang_flagged() or self.drain_requested():
+            return True
         return self._stop_event is not None and self._stop_event.is_set()
 
     def deadline_exceeded(self) -> bool:
         return self._deadline is not None and time.monotonic() > self._deadline
+
+    def drain_requested(self) -> bool:
+        """True once the orchestrator wants the cohort to checkpoint and
+        return at its next step boundary (preemption drain)."""
+        return self._drain_event is not None and self._drain_event.is_set()
+
+    def hang_flagged(self) -> bool:
+        return self._hang_event is not None and self._hang_event.is_set()
 
     # -- settlement (run_cohort internals) ---------------------------------
 
@@ -273,6 +294,15 @@ class CohortContext:
                 TrialCondition.EARLY_STOPPED,
                 triggered.describe() if triggered is not None else "early stopped",
             )
+        if self.hang_flagged():
+            # retryable: the member rejoins as a singleton from its last
+            # checkpoint through the orchestrator's retry machinery
+            return TrialResult(
+                TrialCondition.FAILED,
+                "hang watchdog: cohort made no step progress past "
+                "progress_deadline_seconds",
+                failure_kind=FailureKind.HANG,
+            )
         if self.deadline_exceeded():
             return TrialResult(
                 TrialCondition.FAILED,
@@ -282,6 +312,10 @@ class CohortContext:
         if self._stop_event is not None and self._stop_event.is_set():
             return TrialResult(
                 TrialCondition.KILLED, "experiment reached terminal state"
+            )
+        if self.drain_requested():
+            return TrialResult(
+                TrialCondition.DRAINED, "checkpointed and exited for drain"
             )
         return _finalize(self.members[i], self._store, self._objective)
 
@@ -293,6 +327,8 @@ def run_cohort(
     mesh=None,
     stop_event: threading.Event | None = None,
     injector=None,
+    watchdog=None,
+    drain_event: threading.Event | None = None,
 ) -> dict[str, TrialResult]:
     """Execute K trials as one vectorized cohort; returns a per-trial-name
     result map.  Never raises: a cohort-path failure falls back to serial
@@ -304,7 +340,10 @@ def run_cohort(
     cohort_fn = cohort_fn_of(trials[0].spec.train_fn)
     if len(trials) == 1 or cohort_fn is None:
         for t in trials:
-            results[t.name] = run_trial(t, store, objective, mesh, stop_event, injector)
+            results[t.name] = run_trial(
+                t, store, objective, mesh, stop_event, injector,
+                watchdog=watchdog, drain_event=drain_event,
+            )
         return results
 
     # chaos seam parity with run_trial: injected faults fire per member and
@@ -327,12 +366,34 @@ def run_cohort(
         return results
     if len(survivors) == 1:
         t = survivors[0]
-        results[t.name] = run_trial(t, store, objective, mesh, stop_event)
+        results[t.name] = run_trial(
+            t, store, objective, mesh, stop_event,
+            watchdog=watchdog, drain_event=drain_event,
+        )
         return results
 
     k = len(survivors)
     key = survivors[0].spec.labels.get(COHORT_KEY_LABEL, "")
-    ctx = CohortContext(survivors, store, objective, mesh=mesh, stop_event=stop_event)
+    # one heartbeat for the whole cohort (members share one compiled
+    # program, so they stall together): tightest member deadline wins
+    hang_event = threading.Event()
+    heartbeat = None
+    deadlines = [
+        t.spec.progress_deadline_seconds
+        for t in survivors
+        if t.spec.progress_deadline_seconds
+    ]
+    if watchdog is not None and deadlines:
+        heartbeat = watchdog.register(
+            f"cohort:{key or survivors[0].name}",
+            min(deadlines),
+            on_hang=lambda _name: hang_event.set(),
+        )
+    ctx = CohortContext(
+        survivors, store, objective, mesh=mesh, stop_event=stop_event,
+        drain_event=drain_event, hang_event=hang_event,
+        heartbeat=heartbeat.beat if heartbeat is not None else None,
+    )
     devices = ctx.trial_devices
     started = time.perf_counter()
     try:
@@ -350,8 +411,14 @@ def run_cohort(
         # from the partial cohort are tolerated by the store's reduction)
         obs.cohort_fallbacks.inc()
         for t in survivors:
-            results[t.name] = run_trial(t, store, objective, mesh, stop_event)
+            results[t.name] = run_trial(
+                t, store, objective, mesh, stop_event,
+                watchdog=watchdog, drain_event=drain_event,
+            )
         return results
+    finally:
+        if heartbeat is not None:
+            heartbeat.close()
     elapsed = max(time.perf_counter() - started, 1e-9)
 
     obs.cohorts_executed.inc()

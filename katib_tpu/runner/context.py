@@ -43,6 +43,9 @@ class TrialContext:
         labels: Mapping[str, str] | None = None,
         stop_event: Any = None,
         max_runtime_seconds: float | None = None,
+        drain_event: Any = None,
+        hang_event: Any = None,
+        heartbeat: Any = None,
     ):
         self.trial_name = trial_name
         self.params = dict(params)
@@ -52,6 +55,15 @@ class TrialContext:
         self.mesh = mesh
         self.labels = dict(labels or {})
         self._stop_event = stop_event
+        # orchestrator drain (preemption SIGTERM): checkpoint-and-exit at the
+        # next step boundary — report()/should_stop() turn the flag into a
+        # cooperative unwind, the runner settles the trial DRAINED
+        self._drain_event = drain_event
+        # hang watchdog verdict (utils/watchdog.py): set by the monitor
+        # thread when no heartbeat landed for progress_deadline_seconds
+        self._hang_event = hang_event
+        # called on every report() — the watchdog heartbeat
+        self._heartbeat = heartbeat
         self._step = 0
         self._checkpointer = None
         # cooperative wall-clock deadline: report()/should_stop() turn False/
@@ -71,6 +83,8 @@ class TrialContext:
         ``ctx.report(accuracy=0.91, loss=0.3, step=epoch)`` replaces the
         reference's ``print("accuracy=0.91")`` + sidecar regex scrape.
         """
+        if self._heartbeat is not None:
+            self._heartbeat()
         if step is None:
             step = self._step
             self._step += 1
@@ -97,16 +111,34 @@ class TrialContext:
             return True
         if self.deadline_exceeded():
             return True
+        if self.hang_flagged() or self.drain_requested():
+            return True
         return self._stop_event is not None and self._stop_event.is_set()
 
     def deadline_exceeded(self) -> bool:
         return self._deadline is not None and time.monotonic() > self._deadline
+
+    def drain_requested(self) -> bool:
+        """True once the orchestrator received SIGTERM/SIGINT and wants this
+        trial to checkpoint and return at its next step boundary.  A trial
+        that saves each epoch before ``report()`` needs no extra code — the
+        report's False return unwinds it after the save."""
+        return self._drain_event is not None and self._drain_event.is_set()
+
+    def hang_flagged(self) -> bool:
+        """True once the hang watchdog classified this trial as stalled (the
+        runner settles it ``FailureKind.HANG`` when the train_fn unwinds)."""
+        return self._hang_event is not None and self._hang_event.is_set()
 
     def raise_if_stopped(self) -> None:
         if self._evaluator is not None and self._evaluator.should_stop():
             raise TrialEarlyStopped(self._evaluator.triggered.describe())
         if self.deadline_exceeded():
             raise TrialEarlyStopped("trial max_runtime exceeded")
+        if self.hang_flagged():
+            raise TrialEarlyStopped("hang watchdog interrupted the trial")
+        if self.drain_requested():
+            raise TrialEarlyStopped("orchestrator draining (preemption)")
         if self._stop_event is not None and self._stop_event.is_set():
             raise TrialEarlyStopped("experiment reached terminal state")
 

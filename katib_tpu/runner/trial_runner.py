@@ -116,6 +116,8 @@ def run_trial(
     mesh=None,
     stop_event: threading.Event | None = None,
     injector=None,
+    watchdog=None,
+    drain_event: threading.Event | None = None,
 ) -> TrialResult:
     """Execute one trial to a terminal condition.  Never raises: failures
     become ``TrialCondition.FAILED`` with the traceback in ``message`` and
@@ -124,7 +126,12 @@ def run_trial(
 
     ``injector`` (a ``faults.FaultInjector``) is the chaos seam: it fires
     inside this classification try-block, so injected faults take exactly
-    the path a real preemption or shape error would."""
+    the path a real preemption or shape error would.
+
+    ``watchdog`` (``utils.watchdog.Watchdog``) arms hang detection when the
+    trial carries ``progress_deadline_seconds``; ``drain_event`` is the
+    orchestrator's checkpoint-and-exit request (preemption SIGTERM) — both
+    observable to the train_fn through its context."""
     if mesh is not None:
         # a trial-axis-only mesh partitions cohort MEMBERS, not tensors: a
         # singleton (cohort fallback, transient-member rejoin) has no data
@@ -138,9 +145,15 @@ def run_trial(
             injector.on_trial_attempt(trial)
             injector.apply_metrics_delay(trial, stop_event)
         if trial.spec.train_fn is not None:
-            return _run_whitebox(trial, store, evaluator, objective, mesh, stop_event)
+            return _run_whitebox(
+                trial, store, evaluator, objective, mesh, stop_event,
+                injector=injector, watchdog=watchdog, drain_event=drain_event,
+            )
         if trial.spec.command:
-            return _run_blackbox(trial, store, evaluator, objective, stop_event)
+            return _run_blackbox(
+                trial, store, evaluator, objective, stop_event,
+                watchdog=watchdog, drain_event=drain_event,
+            )
         return TrialResult(
             TrialCondition.FAILED,
             "trial has neither train_fn nor command",
@@ -174,7 +187,18 @@ def _run_whitebox(
     objective,
     mesh,
     stop_event: threading.Event | None,
+    injector=None,
+    watchdog=None,
+    drain_event: threading.Event | None = None,
 ) -> TrialResult:
+    hang_event = threading.Event()
+    heartbeat = None
+    if watchdog is not None and trial.spec.progress_deadline_seconds:
+        heartbeat = watchdog.register(
+            trial.name,
+            trial.spec.progress_deadline_seconds,
+            on_hang=lambda _name: hang_event.set(),
+        )
     ctx = TrialContext(
         trial_name=trial.name,
         params=trial.params(),
@@ -185,6 +209,9 @@ def _run_whitebox(
         labels=trial.spec.labels,
         stop_event=stop_event,
         max_runtime_seconds=trial.spec.max_runtime_seconds,
+        drain_event=drain_event,
+        hang_event=hang_event,
+        heartbeat=heartbeat.beat if heartbeat is not None else None,
     )
 
     def _deadline_result() -> TrialResult:
@@ -196,14 +223,34 @@ def _run_whitebox(
             failure_kind=FailureKind.PERMANENT,
         )
 
+    def _hang_result() -> TrialResult:
+        return TrialResult(
+            TrialCondition.FAILED,
+            "hang watchdog: no progress for "
+            f"progress_deadline_seconds={trial.spec.progress_deadline_seconds}",
+            failure_kind=FailureKind.HANG,
+        )
+
     try:
+        if injector is not None:
+            # chaos 'hang' action: wedge here like a stuck compile; only the
+            # watchdog / stop / drain machinery can unwedge it — and whichever
+            # did decides the settlement (HANG / KILLED / DRAINED)
+            injector.maybe_hang(trial, events=(hang_event, stop_event, drain_event))
+            ctx.raise_if_stopped()
         with tracing.span("train_fn", trial=trial.name):
             trial.spec.train_fn(ctx)
     except TrialEarlyStopped as e:
         if evaluator.triggered is not None:
             return TrialResult(TrialCondition.EARLY_STOPPED, str(e))
+        if hang_event.is_set():
+            return _hang_result()
         if ctx.deadline_exceeded():
             return _deadline_result()
+        if ctx.drain_requested() and not (stop_event is not None and stop_event.is_set()):
+            return TrialResult(
+                TrialCondition.DRAINED, "checkpointed and exited for drain"
+            )
         return TrialResult(TrialCondition.KILLED, str(e))
     except Exception as e:
         return TrialResult(
@@ -211,12 +258,21 @@ def _run_whitebox(
             traceback.format_exc(limit=20),
             failure_kind=classify_exception(e),
         )
+    finally:
+        if heartbeat is not None:
+            heartbeat.close()
     if evaluator.should_stop():
         return TrialResult(TrialCondition.EARLY_STOPPED, evaluator.triggered.describe())
+    if hang_event.is_set():
+        return _hang_result()
     if ctx.deadline_exceeded():
         return _deadline_result()
     if stop_event is not None and stop_event.is_set():
         return TrialResult(TrialCondition.KILLED, "experiment reached terminal state")
+    if ctx.drain_requested():
+        # the train_fn unwound at a step boundary; its last checkpoint (if
+        # any) is on disk and the resumed run re-submits this trial
+        return TrialResult(TrialCondition.DRAINED, "checkpointed and exited for drain")
     return _finalize(trial, store, objective)
 
 
@@ -414,6 +470,8 @@ def _run_blackbox(
     evaluator: RuleEvaluator,
     objective,
     stop_event: threading.Event | None,
+    watchdog=None,
+    drain_event: threading.Event | None = None,
 ) -> TrialResult:
     collector = trial.spec.metrics_collector
     # the collector path renders like the command (per-trial file paths via
@@ -500,36 +558,75 @@ def _run_blackbox(
     early_stopped = False
     killed = False
     deadline_hit = False
+    hanged = False
+    drained = False
     deadline = (
         time.monotonic() + trial.spec.max_runtime_seconds
         if trial.spec.max_runtime_seconds is not None
         else None
     )
+    # hang watchdog: progress = any polled metric line OR the metrics file's
+    # mtime moving (a trainer mid-epoch appends without completing a line);
+    # a stall past progress_deadline_seconds SIGTERMs through the same
+    # escalation as the deadline, classified FailureKind.HANG
+    hang_event = threading.Event()
+    heartbeat = None
+    if watchdog is not None and trial.spec.progress_deadline_seconds:
+        heartbeat = watchdog.register(
+            trial.name,
+            trial.spec.progress_deadline_seconds,
+            on_hang=lambda _name: hang_event.set(),
+        )
+    last_mtime: float | None = None
     terminate_at: float | None = None
-    while True:
-        polled = parse(source.poll())
-        if prom is not None:
-            polled += prom.poll()
-        for log in polled:
-            store.report(trial.name, [log])
-            if evaluator.observe(log.metric_name, log.value):
-                early_stopped = True
-        if stop_event is not None and stop_event.is_set():
-            killed = True
-        if deadline is not None and time.monotonic() > deadline:
-            # per-trial wall-clock bound: SIGTERM (then SIGKILL below) the
-            # hung trial instead of pinning an orchestrator slot forever
-            deadline_hit = True
-        if (early_stopped or killed or deadline_hit) and terminate_at is None:
-            _signal_group(proc, signal.SIGTERM)
-            terminate_at = time.monotonic()
-        if terminate_at is not None and time.monotonic() - terminate_at > 10.0:
-            # SIGTERM ignored; escalate (classification unchanged)
-            _signal_group(proc, signal.SIGKILL)
-            terminate_at = float("inf")
-        if proc.poll() is not None:
-            break
-        time.sleep(0.05)
+    try:
+        while True:
+            raw = source.poll()
+            polled = parse(raw)
+            if prom is not None:
+                polled += prom.poll()
+            if heartbeat is not None:
+                progressed = bool(raw) or bool(polled)
+                if use_file and not progressed:
+                    try:
+                        mtime = os.stat(collector.path).st_mtime
+                        progressed = mtime != last_mtime
+                        last_mtime = mtime
+                    except OSError:
+                        pass
+                if progressed:
+                    heartbeat.beat()
+            for log in polled:
+                store.report(trial.name, [log])
+                if evaluator.observe(log.metric_name, log.value):
+                    early_stopped = True
+            if stop_event is not None and stop_event.is_set():
+                killed = True
+            if hang_event.is_set():
+                hanged = True
+            if drain_event is not None and drain_event.is_set():
+                # ask the trainer to exit (its own SIGTERM handler may
+                # checkpoint); the escalation below bounds a deaf one
+                drained = True
+            if deadline is not None and time.monotonic() > deadline:
+                # per-trial wall-clock bound: SIGTERM (then SIGKILL below) the
+                # hung trial instead of pinning an orchestrator slot forever
+                deadline_hit = True
+            if (
+                early_stopped or killed or deadline_hit or hanged or drained
+            ) and terminate_at is None:
+                _signal_group(proc, signal.SIGTERM)
+                terminate_at = time.monotonic()
+            if terminate_at is not None and time.monotonic() - terminate_at > 10.0:
+                # SIGTERM ignored; escalate (classification unchanged)
+                _signal_group(proc, signal.SIGKILL)
+                terminate_at = float("inf")
+            if proc.poll() is not None:
+                break
+            time.sleep(0.05)
+    finally:
+        if heartbeat is not None:
+            heartbeat.close()
     rc = proc.wait()
     tracing.record_span(
         "subprocess", time.perf_counter() - launched_at, trial=trial.name, rc=rc
@@ -553,6 +650,13 @@ def _run_blackbox(
 
     if early_stopped:
         return TrialResult(TrialCondition.EARLY_STOPPED, evaluator.triggered.describe())
+    if hanged:
+        return TrialResult(
+            TrialCondition.FAILED,
+            "hang watchdog: no metric progress for "
+            f"progress_deadline_seconds={trial.spec.progress_deadline_seconds}",
+            failure_kind=FailureKind.HANG,
+        )
     if deadline_hit:
         return TrialResult(
             TrialCondition.FAILED,
@@ -560,6 +664,10 @@ def _run_blackbox(
         )
     if killed:
         return TrialResult(TrialCondition.KILLED, "experiment reached terminal state")
+    if drained:
+        return TrialResult(
+            TrialCondition.DRAINED, "terminated for drain (resume re-runs it)"
+        )
     if rc != 0:
         return TrialResult(
             TrialCondition.FAILED,

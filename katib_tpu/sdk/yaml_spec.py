@@ -343,6 +343,12 @@ def experiment_spec_from_dict(data: Mapping[str, Any]) -> ExperimentSpec:
         max_retries=int(spec.get("maxRetries", 0)),
         retry_backoff_seconds=float(spec.get("retryBackoffSeconds", 1.0)),
         suggester_max_errors=int(spec.get("suggesterMaxErrors", 5)),
+        progress_deadline_seconds=(
+            float(spec["progressDeadlineSeconds"])
+            if spec.get("progressDeadlineSeconds") is not None
+            else None
+        ),
+        drain_grace_seconds=float(spec.get("drainGraceSeconds", 30.0)),
         cohort_width=int(spec.get("cohortWidth", 1)),
         cohort_key=(
             str(spec["cohortKey"]) if spec.get("cohortKey") is not None else None

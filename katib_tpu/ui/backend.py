@@ -608,6 +608,9 @@ async function counters(){
     `${tot('katib_trial_retried_total')} retried · `+
     `${tot('katib_trial_early_stopped_total')} early-stopped · `+
     `experiments running: ${tot('katib_experiments_current')}`+
+    (tot('katib_trial_hangs_total')?` · hangs caught: ${tot('katib_trial_hangs_total')}`:'')+
+    (tot('katib_checkpoint_fallback_total')?` · ckpt fallbacks: ${tot('katib_checkpoint_fallback_total')}`:'')+
+    (tot('katib_drain_requested')?' · <b>DRAINING</b>':'')+
     (tot('katib_suggester_errors_total')?` · suggester errors: ${tot('katib_suggester_errors_total')}`:'')+
     (tot('katib_cohort_executed_total')?` · cohorts: ${tot('katib_cohort_executed_total')}`:'')+
     (mean!==null?` · mean trial ${mean.toFixed(1)}s`:'')+'</small>';

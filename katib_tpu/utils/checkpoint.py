@@ -11,7 +11,18 @@ preemption recovery all move the same artifacts.
 
 Layout under a trial's checkpoint directory::
 
-    <dir>/step_00000010/   # one Orbax PyTree checkpoint per retained step
+    <dir>/step_00000010/               # one Orbax PyTree checkpoint per step
+    <dir>/step_00000010.manifest.json  # sidecar: file sizes + tree digest
+    <dir>/quarantine-step_00000012/    # a step restore() refused (corrupt)
+
+The sidecar manifest (written after the Orbax commit succeeds) is what makes
+``restore()`` preemption-proof: a step whose files are missing, truncated,
+or whose pytree-structure digest changed is *quarantined* (renamed aside for
+post-mortem) and restore falls back to the newest step that still verifies,
+instead of making the latest write a single point of failure for the whole
+resume story.  Steps without a manifest (pre-manifest layouts, hand-copied
+dirs) are attempted best-effort and quarantined only if Orbax itself rejects
+them.
 
 PBT lineage needs no special casing: the suggester copies the parent's
 whole directory tree before the child trial starts, and the child's
@@ -20,16 +31,51 @@ whole directory tree before the child trial starts, and the child's
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import re
 import shutil
+import tempfile
 from typing import Any
 
 _STEP_DIR = re.compile(r"^step_(\d{8})$")
+_MANIFEST_SUFFIX = ".manifest.json"
+_QUARANTINE_PREFIX = "quarantine-"
 
 
 def _step_path(directory: str, step: int) -> str:
     return os.path.join(directory, f"step_{step:08d}")
+
+
+def _manifest_path(directory: str, step: int) -> str:
+    return _step_path(directory, step) + _MANIFEST_SUFFIX
+
+
+def _tree_digest(pytree: Any) -> str:
+    """Structure digest of a pytree: treedef + per-leaf shape/dtype, hashed.
+    Catches a manifest paired with a *different* trial's step (PBT copy gone
+    wrong) without reading any array data."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(pytree)
+    parts = [str(treedef)]
+    for leaf in leaves:
+        parts.append(f"{getattr(leaf, 'shape', ())}:{getattr(leaf, 'dtype', type(leaf).__name__)}")
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()
+
+
+def _walk_sizes(step_dir: str) -> dict[str, int]:
+    sizes: dict[str, int] = {}
+    for root, _, files in os.walk(step_dir):
+        for fname in files:
+            full = os.path.join(root, fname)
+            rel = os.path.relpath(full, step_dir)
+            try:
+                sizes[rel] = os.path.getsize(full)
+            except OSError:
+                sizes[rel] = -1
+    return sizes
 
 
 class TrialCheckpointer:
@@ -68,11 +114,66 @@ class TrialCheckpointer:
         steps = self.all_steps()
         return steps[-1] if steps else None
 
+    def verify_step(self, step: int) -> bool | None:
+        """Check a step against its sidecar manifest: True = verified,
+        False = provably damaged (missing/resized files, step mismatch),
+        None = no manifest to check against (legacy/hand-copied step)."""
+        step_dir = _step_path(self.directory, step)
+        if not os.path.isdir(step_dir):
+            return False
+        try:
+            with open(_manifest_path(self.directory, step)) as f:
+                manifest = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+        if manifest.get("step") != step:
+            return False
+        for rel, size in (manifest.get("files") or {}).items():
+            full = os.path.join(step_dir, rel)
+            try:
+                if os.path.getsize(full) != size:
+                    return False
+            except OSError:
+                return False
+        return True
+
+    def quarantine_step(self, step: int, reason: str = "") -> None:
+        """Move a damaged step (and its manifest) aside for post-mortem;
+        ``all_steps()`` no longer sees it.  Best-effort: an unmovable dir is
+        deleted instead so restore cannot pick it again."""
+        step_dir = _step_path(self.directory, step)
+        target = os.path.join(
+            self.directory, f"{_QUARANTINE_PREFIX}step_{step:08d}"
+        )
+        suffix = 0
+        while os.path.exists(target):
+            suffix += 1
+            target = os.path.join(
+                self.directory, f"{_QUARANTINE_PREFIX}step_{step:08d}.{suffix}"
+            )
+        try:
+            os.rename(step_dir, target)
+            if reason:
+                with open(os.path.join(target, "QUARANTINE_REASON"), "w") as f:
+                    f.write(reason + "\n")
+        except OSError:
+            shutil.rmtree(step_dir, ignore_errors=True)
+        manifest = _manifest_path(self.directory, step)
+        try:
+            os.replace(manifest, target + _MANIFEST_SUFFIX)
+        except OSError:
+            pass
+
     # -- save / restore ------------------------------------------------------
 
     def save(self, pytree: Any, step: int, *, force: bool = True) -> str:
         """Write ``pytree`` as the checkpoint for ``step``; prunes old steps
-        beyond ``max_to_keep``.  Returns the checkpoint path."""
+        beyond ``max_to_keep``.  Returns the checkpoint path.
+
+        After the Orbax commit succeeds a sidecar manifest (per-file sizes +
+        pytree structure digest) is written beside the step dir — the
+        verification record ``restore()`` uses to refuse half-written steps
+        after a preemption."""
         os.makedirs(self.directory, exist_ok=True)
         path = _step_path(self.directory, step)
         if os.path.exists(path):
@@ -80,42 +181,106 @@ class TrialCheckpointer:
                 raise FileExistsError(path)
             shutil.rmtree(path)
         self._checkpointer().save(path, pytree)
+        self._write_manifest(pytree, step, path)
         if self.max_to_keep is not None and self.max_to_keep > 0:
             for old in self.all_steps()[: -self.max_to_keep]:
                 shutil.rmtree(_step_path(self.directory, old), ignore_errors=True)
+                try:
+                    os.unlink(_manifest_path(self.directory, old))
+                except OSError:
+                    pass
         return path
 
+    def _write_manifest(self, pytree: Any, step: int, step_dir: str) -> None:
+        # best-effort (a manifest-less step still restores, just unverified);
+        # written atomically so a preemption mid-write can't leave a manifest
+        # that condemns a perfectly good step
+        try:
+            doc = {
+                "step": step,
+                "tree_digest": _tree_digest(pytree),
+                "files": _walk_sizes(step_dir),
+            }
+            fd, tmp = tempfile.mkstemp(dir=self.directory, prefix=".manifest-")
+            try:
+                with os.fdopen(fd, "w") as f:
+                    json.dump(doc, f)
+                os.replace(tmp, _manifest_path(self.directory, step))
+            except BaseException:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+                raise
+        except Exception:
+            pass
+
     def restore(self, template: Any = None, step: int | None = None):
-        """Restore ``(pytree, step)``; ``None`` when no checkpoint exists.
+        """Restore ``(pytree, step)``; ``None`` when no restorable checkpoint
+        exists (cold start).
+
+        Last-good recovery: without an explicit ``step``, candidates are
+        tried newest-first.  A step that fails manifest verification or whose
+        Orbax restore raises is quarantined (``quarantine-step_XXXXXXXX``),
+        ``katib_checkpoint_fallback_total`` is bumped, and the next-older
+        step is tried — a torn latest write costs one step of progress, not
+        the whole trial.
 
         ``template`` (a pytree of arrays or ShapeDtypeStructs) pins the
         restored structure/sharding; without it Orbax returns its default
         representation (nested dicts of numpy arrays).
         """
-        if step is None:
-            step = self.latest_step()
-            if step is None:
-                return None
-        path = _step_path(self.directory, step)
-        if not os.path.isdir(path):
-            return None
+        if step is not None:
+            candidates = [step]
+        else:
+            candidates = list(reversed(self.all_steps()))
+        for i, cand in enumerate(candidates):
+            path = _step_path(self.directory, cand)
+            if not os.path.isdir(path):
+                if step is not None:
+                    return None
+                continue
+            verdict = self.verify_step(cand)
+            if verdict is False:
+                self._fallback(cand, "manifest verification failed")
+                continue
+            try:
+                restored = self._restore_step(path, template)
+            except Exception as e:
+                self._fallback(cand, f"restore raised {type(e).__name__}: {e}")
+                continue
+            return restored, cand
+        return None
+
+    def _restore_step(self, path: str, template: Any):
         if template is not None:
             import orbax.checkpoint as ocp
 
-            restored = self._checkpointer().restore(
+            return self._checkpointer().restore(
                 path, args=ocp.args.PyTreeRestore(template)
             )
-        else:
-            restored = self._checkpointer().restore(path)
-        return restored, step
+        return self._checkpointer().restore(path)
+
+    def _fallback(self, step: int, reason: str) -> None:
+        self.quarantine_step(step, reason)
+        from katib_tpu.utils import observability as obs
+
+        obs.checkpoint_fallbacks.inc()
 
 
 def copy_checkpoint_tree(src_dir: str, dst_dir: str) -> bool:
     """PBT exploit: clone a parent trial's full checkpoint lineage directory.
-    Returns False when the parent has nothing yet (the child cold-starts)."""
+    Returns False when the parent has nothing yet (the child cold-starts).
+
+    Crash-safe: the copy lands in a ``.tmp`` sibling first and is renamed
+    into place only when complete, so a process killed mid-copy leaves either
+    the previous ``dst_dir`` or none — never a half-copied lineage whose
+    latest step restores garbage into the child."""
     if not os.path.isdir(src_dir):
         return False
+    tmp_dir = dst_dir.rstrip("/\\") + ".tmp"
+    if os.path.isdir(tmp_dir):
+        shutil.rmtree(tmp_dir)  # leftover from an interrupted earlier copy
+    shutil.copytree(src_dir, tmp_dir)
     if os.path.isdir(dst_dir):
         shutil.rmtree(dst_dir)
-    shutil.copytree(src_dir, dst_dir)
+    os.rename(tmp_dir, dst_dir)
     return True

@@ -50,6 +50,17 @@ class FailureKind(str, enum.Enum):
 
     TRANSIENT = "Transient"
     PERMANENT = "Permanent"
+    # no-progress stall past progressDeadlineSeconds, classified by the hang
+    # watchdog (utils/watchdog.py).  Retryable like TRANSIENT: a wedged
+    # compile or deadlocked collective usually clears on a re-run from the
+    # last checkpoint, unlike a deterministic shape bug.
+    HANG = "Hang"
+
+    @property
+    def retryable(self) -> bool:
+        """Whether the orchestrator's bounded retry loop should re-run the
+        attempt (same trial name + checkpoint dir)."""
+        return self in (FailureKind.TRANSIENT, FailureKind.HANG)
 
 
 # Infrastructure-failure markers inside exception text / tracebacks.  TPU
@@ -295,6 +306,11 @@ class FaultInjector:
       ``step`` before trial k's next attempt (fires once);
     - ``delay_metrics(k, d)``    — stall trial k's metric production by d
       seconds each attempt (stop-event responsive);
+    - ``hang_trial(k, j)``       — wedge trial k's attempt j inside the
+      white-box step (sleeps until interrupted — the hang watchdog's
+      ``progressDeadlineSeconds`` path must catch it);
+    - ``preempt_at(k)``          — deliver SIGTERM to this process when
+      trial k starts (fires once — exercises the orchestrator drain path);
     - ``flake(rate, kind)``      — seeded random per-attempt failures.
 
     The seams (``on_trial_attempt`` / ``on_suggester_call`` /
@@ -312,6 +328,8 @@ class FaultInjector:
         self._suggester_calls: set[int] = set()
         self._corruptions: dict[object, list[int]] = {}
         self._metric_delays: dict[object, float] = {}
+        self._hangs: set[tuple[object, int]] = set()
+        self._preempts: set[object] = set()
         self._flake_rate = 0.0
         self._flake_kind = FailureKind.TRANSIENT
         self._order: dict[str, int] = {}  # trial name -> creation index
@@ -335,6 +353,20 @@ class FaultInjector:
 
     def delay_metrics(self, trial, seconds: float):
         self._metric_delays[trial] = float(seconds)
+        return self
+
+    def hang_trial(self, trial, attempt: int = 1):
+        """Wedge trial ``trial``'s attempt ``attempt`` inside the white-box
+        step: the runner's ``maybe_hang`` seam sleeps until an interruption
+        event (hang watchdog / stop / drain) is set."""
+        self._hangs.add((trial, int(attempt)))
+        return self
+
+    def preempt_at(self, trial):
+        """SIGTERM this process when trial ``trial`` (creation index or
+        name) starts — the deterministic stand-in for a TPU preemption
+        notice; ``katib-tpu run``'s drain handler takes it from there."""
+        self._preempts.add(trial)
         return self
 
     def flake(self, rate: float, kind=FailureKind.TRANSIENT):
@@ -361,6 +393,12 @@ class FaultInjector:
             corrupt_steps = []
             for key in self._keys(name, idx):
                 corrupt_steps += self._corruptions.pop(key, [])
+            preempt = False
+            for key in self._keys(name, idx):
+                if key in self._preempts:
+                    self._preempts.discard(key)
+                    preempt = True
+                    break
             kind = None
             for key in self._keys(name, idx):
                 if (key, attempt) in self._trial_faults:
@@ -370,6 +408,13 @@ class FaultInjector:
                 kind = self._flake_kind
         for step in corrupt_steps:
             self._corrupt_step(trial.checkpoint_dir, step, name)
+        if preempt:
+            # the signal is asynchronous: this attempt keeps running and the
+            # orchestrator's drain handler asks it to checkpoint-and-exit
+            self.log.append({"seam": "preempt", "trial": name, "attempt": attempt})
+            import signal as _signal
+
+            os.kill(os.getpid(), _signal.SIGTERM)
         if kind is not None:
             self.log.append(
                 {"seam": "trial", "trial": name, "attempt": attempt, "kind": kind.value}
@@ -407,10 +452,37 @@ class FaultInjector:
         else:
             time.sleep(delay)
 
+    def maybe_hang(self, trial, events: tuple = (), poll: float = 0.02) -> None:
+        """Runner seam, called inside the white-box trial body: when a
+        ``hang_trial`` spec matches the current attempt, wedge here —
+        sleeping until any of ``events`` (hang-watchdog flag, stop, drain)
+        is set — exactly like a stuck compile or deadlocked collective.
+        Fires once per (trial, attempt)."""
+        name = trial.name
+        with self._lock:
+            idx = self._order.get(name)
+            attempt = self._attempts.get(name, 1)
+            key = None
+            for k in self._keys(name, idx):
+                if (k, attempt) in self._hangs:
+                    key = (k, attempt)
+                    break
+            if key is None:
+                return
+            self._hangs.discard(key)
+        self.log.append({"seam": "hang", "trial": name, "attempt": attempt})
+        live = [e for e in events if e is not None]
+        while not any(e.is_set() for e in live):
+            time.sleep(poll)
+
     def _corrupt_step(self, checkpoint_dir: str | None, step: int, name: str) -> None:
         if not checkpoint_dir:
             return
-        step_dir = os.path.join(checkpoint_dir, str(step))
+        # TrialCheckpointer lays steps out as step_%08d; accept a bare
+        # str(step) dir too for non-Orbax custom layouts
+        step_dir = os.path.join(checkpoint_dir, f"step_{int(step):08d}")
+        if not os.path.isdir(step_dir):
+            step_dir = os.path.join(checkpoint_dir, str(step))
         if not os.path.isdir(step_dir):
             return
         self.log.append({"seam": "checkpoint", "trial": name, "step": step})

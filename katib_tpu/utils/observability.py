@@ -437,6 +437,24 @@ compile_cache_enabled = REGISTRY.gauge(
     "(KATIB_COMPILE_CACHE / ExperimentSpec.compile_cache)",
 )
 
+# -- preemption / hang robustness (utils/watchdog.py, orchestrator drain) -----
+
+trial_hangs = REGISTRY.counter(
+    "katib_trial_hangs_total",
+    "Trials interrupted by the hang watchdog "
+    "(no progress past progressDeadlineSeconds)",
+)
+drain_requested = REGISTRY.gauge(
+    "katib_drain_requested",
+    "1 while the orchestrator is draining after SIGTERM/SIGINT "
+    "(checkpoint-and-exit requested; run is resumable)",
+)
+checkpoint_fallbacks = REGISTRY.counter(
+    "katib_checkpoint_fallback_total",
+    "Corrupt/unverifiable checkpoint steps skipped by restore() "
+    "(quarantined; an older verifiable step was used instead)",
+)
+
 
 def record_device_memory(registry_gauge: _Metric | None = None) -> None:
     """Best-effort per-device memory gauges via ``Device.memory_stats()``

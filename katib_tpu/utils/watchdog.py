@@ -1,0 +1,147 @@
+"""Hang watchdog: heartbeat registry + monitor thread for stuck trials.
+
+The cooperative deadline in ``TrialContext`` is only polled at reporting
+points (``runner/context.py``), so a white-box trial wedged *between*
+``report()`` calls — a stuck XLA compile, a deadlocked collective, an infeed
+stall — pins its orchestrator slot forever.  The reference has no analog
+(a hung pod is eventually reaped by Kubernetes liveness machinery); on a
+single-process TPU orchestrator the watchdog is that machinery:
+
+- trials ``register()`` a heartbeat with their ``progress_deadline_seconds``;
+- progress signals ``beat()`` it: white-box trials via ``TrialContext.report``,
+  cohorts at step boundaries via ``CohortContext.report``, black-box trials
+  from the runner's poll loop on metric-line/metric-file-mtime activity;
+- a single monitor daemon thread scans all registered heartbeats; one that
+  goes silent past its deadline fires its ``on_hang`` callback exactly once
+  and bumps ``katib_trial_hangs_total``.
+
+``on_hang`` is the interruption seam: the white-box runner passes an event
+setter the trial observes cooperatively through ``ctx.should_stop()``, the
+black-box runner triggers its existing SIGTERM→SIGKILL escalation.  The
+resulting failure classifies as :class:`~katib_tpu.utils.faults.FailureKind`
+``HANG`` — retryable, so the orchestrator's PR-2 retry machinery re-runs the
+trial from its last checkpoint.
+
+Stdlib-only (no jax) and clock-injectable for deterministic tests.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+
+class Heartbeat:
+    """One registered trial's progress pulse.  ``beat()`` is the only method
+    trial code touches; it is safe from any thread and allocation-free."""
+
+    __slots__ = ("name", "deadline", "on_hang", "_last", "_fired", "_wd")
+
+    def __init__(self, wd: "Watchdog", name: str, deadline: float, on_hang):
+        self._wd = wd
+        self.name = name
+        self.deadline = float(deadline)
+        self.on_hang = on_hang
+        self._last = wd._clock()
+        self._fired = False
+
+    def beat(self) -> None:
+        """Record progress (resets the stall clock)."""
+        self._last = self._wd._clock()
+
+    @property
+    def fired(self) -> bool:
+        """True once the watchdog classified this trial as hung."""
+        return self._fired
+
+    def close(self) -> None:
+        self._wd.unregister(self)
+
+
+class Watchdog:
+    """Heartbeat registry with one shared monitor thread.
+
+    The thread starts lazily on the first ``register()`` and exits on
+    ``stop()`` (or with the process — it is a daemon).  Scanning is O(live
+    trials) every ``interval`` seconds, so detection latency is bounded by
+    ``deadline + interval``.
+    """
+
+    def __init__(self, interval: float = 0.25, clock=time.monotonic):
+        self.interval = float(interval)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._beats: list[Heartbeat] = []
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.hang_count = 0
+
+    def register(
+        self,
+        name: str,
+        deadline: float,
+        on_hang: Callable[[str], None] | None = None,
+    ) -> Heartbeat:
+        """Start watching a trial; returns its :class:`Heartbeat` handle.
+        ``on_hang(name)`` fires at most once, from the monitor thread."""
+        hb = Heartbeat(self, name, deadline, on_hang)
+        with self._lock:
+            self._beats.append(hb)
+            if self._thread is None:
+                self._stop.clear()
+                self._thread = threading.Thread(
+                    target=self._monitor, name="katib-watchdog", daemon=True
+                )
+                self._thread.start()
+        return hb
+
+    def unregister(self, hb: Heartbeat) -> None:
+        with self._lock:
+            try:
+                self._beats.remove(hb)
+            except ValueError:
+                pass
+
+    def stop(self) -> None:
+        """Stop the monitor thread (idempotent); registered heartbeats stay
+        valid but are no longer scanned."""
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=2.0)
+        self._thread = None
+
+    def check_now(self) -> list[str]:
+        """Run one scan synchronously (deterministic tests with a fake
+        clock); returns the names newly classified as hung."""
+        return self._scan()
+
+    # -- internals ----------------------------------------------------------
+
+    def _monitor(self) -> None:
+        while not self._stop.wait(self.interval):
+            self._scan()
+
+    def _scan(self) -> list[str]:
+        now = self._clock()
+        with self._lock:
+            stalled = [
+                hb
+                for hb in self._beats
+                if not hb._fired and now - hb._last > hb.deadline
+            ]
+            for hb in stalled:
+                hb._fired = True
+            self.hang_count += len(stalled)
+        if stalled:
+            from katib_tpu.utils import observability as obs
+
+            for hb in stalled:
+                obs.trial_hangs.inc()
+                if hb.on_hang is not None:
+                    try:
+                        hb.on_hang(hb.name)
+                    except Exception:
+                        pass  # the monitor must outlive a bad callback
+        return [hb.name for hb in stalled]
