@@ -881,6 +881,48 @@ def main() -> None:
     backoff = float(os.environ.get("BENCH_RETRY_BACKOFF", "45"))
     attempt_timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "3600"))
 
+    # Device preflight before any timed phase: a bounded probe of every
+    # visible device in a killable child (utils/meshhealth.py).  A wedged
+    # pool — the exact failure that produced the empty r01–r04 rounds — now
+    # yields a diagnosable partial artifact with a per-device health block
+    # and exit 3 in ~BENCH_PREFLIGHT_DEADLINE seconds, instead of burning
+    # retries × attempt_timeout hanging in device init.
+    health = None
+    if not parse_bool(os.environ.get("BENCH_SKIP_PREFLIGHT")):
+        from katib_tpu.utils import meshhealth
+
+        pf_deadline = float(os.environ.get("BENCH_PREFLIGHT_DEADLINE", "120"))
+        # BENCH_SIMULATE_WEDGE=0,1 rehearses the wedged-pool path (CI smoke)
+        sim = [
+            int(x)
+            for x in os.environ.get("BENCH_SIMULATE_WEDGE", "").split(",")
+            if x.strip()
+        ]
+        pf_report = meshhealth.doctor_report(
+            deadline=pf_deadline, simulate_wedge=sim or None
+        )
+        health = pf_report.to_dict()
+        print(f"bench: preflight {pf_report.summary()}", file=sys.stderr)
+        if not pf_report.ok():
+            aot_block = None
+            if not parse_bool(os.environ.get("BENCH_SKIP_AOT")):
+                aot_block = _run_aot()  # deviceless: safe on a wedged pool
+            committed = _committed_tpu_result()
+            if committed is not None:
+                committed["live_failure_rc"] = 3
+                committed["health"] = health
+                if aot_block is not None:
+                    committed["aot_tpu"] = aot_block
+                print(
+                    "bench: preflight says the pool is wedged but a committed "
+                    "on-chip capture of this exact config exists — emitting it",
+                    file=sys.stderr,
+                )
+                print(json.dumps(committed))
+                return
+            _emit_aot_only(aot_block, 3, health=health)
+            sys.exit(3)
+
     # Pool-proof evidence first: AOT-compile the full-size program against
     # a deviceless v5e topology.  Never touches the relay, and pins
     # flops/HBM/roofline even if every on-chip attempt fails.  A warm
@@ -910,6 +952,8 @@ def main() -> None:
             _persist_tpu_result(result)
             if aot_block is not None:
                 result["aot_tpu"] = aot_block
+            if health is not None:
+                result["health"] = health
             print(json.dumps(result))
             return
         last_rc, last_err = rc, err
@@ -964,6 +1008,8 @@ def main() -> None:
         )
         if aot_block is not None:
             committed["aot_tpu"] = aot_block
+        if health is not None:
+            committed["health"] = health
         print(json.dumps(committed))
         return
     print(
@@ -975,7 +1021,7 @@ def main() -> None:
         file=sys.stderr,
     )
     if parse_bool(os.environ.get("BENCH_NO_FALLBACK")):
-        _emit_aot_only(aot_block, last_rc)
+        _emit_aot_only(aot_block, last_rc, health=health)
         sys.exit(3)
     # honest fallback: a real measurement of the same step at reduced shapes
     # on CPU, explicitly labeled — a recorded number the reader can see is
@@ -995,35 +1041,40 @@ def main() -> None:
             # ...but the deviceless v5e compile is still real TPU evidence:
             # the full-size program's flops, HBM fit, and roofline ceiling
             result["aot_tpu"] = aot_block
+        if health is not None:
+            result["health"] = health
         print(json.dumps(result))
         return
     print(f"bench: CPU fallback also failed rc={rc}:\n{err}", file=sys.stderr)
-    _emit_aot_only(aot_block, last_rc)
+    _emit_aot_only(aot_block, last_rc, health=health)
     sys.exit(3)
 
 
-def _emit_aot_only(aot_block: dict | None, last_rc: int) -> None:
-    """Total-failure exits still print the pool-proof evidence: a JSON line
-    carrying the deviceless v5e compile block (no measured value) so the
-    round's record keeps the flops/HBM/roofline facts even when nothing
+def _emit_aot_only(
+    aot_block: dict | None, last_rc: int, health: dict | None = None
+) -> None:
+    """Total-failure exits still print the diagnosable evidence: a JSON line
+    carrying the deviceless v5e compile block (no measured value) and the
+    per-device preflight health report, so the round's record keeps the
+    flops/HBM/roofline facts — and WHY nothing executed — even when nothing
     could execute anywhere."""
-    if aot_block is None:
+    if aot_block is None and health is None:
         return
-    print(
-        json.dumps(
-            {
-                "metric": "darts_bilevel_search_throughput",
-                "value": None,
-                "unit": "images/sec",
-                "vs_baseline": None,
-                "mfu": None,
-                "tpu_unavailable": True,
-                "tpu_failure": f"rc={last_rc}",
-                "execution_failed": True,
-                "aot_tpu": aot_block,
-            }
-        )
-    )
+    blob = {
+        "metric": "darts_bilevel_search_throughput",
+        "value": None,
+        "unit": "images/sec",
+        "vs_baseline": None,
+        "mfu": None,
+        "tpu_unavailable": True,
+        "tpu_failure": f"rc={last_rc}",
+        "execution_failed": True,
+    }
+    if aot_block is not None:
+        blob["aot_tpu"] = aot_block
+    if health is not None:
+        blob["health"] = health
+    print(json.dumps(blob))
 
 
 if __name__ == "__main__":

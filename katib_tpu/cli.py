@@ -110,6 +110,11 @@ def cmd_run(args: argparse.Namespace) -> int:
     # behind may hard-exit with the resumable code after journaling
     # (library callers keep the default cooperative wind-down instead)
     orch.drain_hard_exit = True
+    # device preflight gate: on by default for CLI runs — a wedged pool
+    # fails fast with a per-device health report instead of hanging in the
+    # first compile.  `--no-preflight` (or leaving KATIB_PREFLIGHT unset in
+    # library embedding) skips the probe.
+    orch.preflight = not args.no_preflight
     if args.drain_grace_seconds is not None:
         spec.drain_grace_seconds = args.drain_grace_seconds
     _install_drain_handlers(orch)
@@ -439,11 +444,22 @@ def cmd_chaos(args: argparse.Namespace) -> int:
         injector.preempt_at(args.preempt_at)
     if args.flake_rate:
         injector.flake(args.flake_rate)
+    for spec_str in args.compile_hang or []:
+        parts = spec_str.split(":")
+        if len(parts) not in (1, 2):
+            print(f"bad --compile-hang {spec_str!r} (want K[:J])", file=sys.stderr)
+            return 2
+        injector.compile_hang(int(parts[0]), int(parts[1]) if len(parts) == 2 else 1)
+    wedge_devices = [int(d) for d in (args.wedge_device or [])]
+    for d in wedge_devices:
+        injector.wedge_device(d)
     injected_any = (
         args.fail_trial
         or args.fail_suggester
         or args.flake_rate
         or args.hang_trial
+        or args.compile_hang
+        or wedge_devices
         or args.preempt_at is not None
     )
     if not injector.log and not injected_any:
@@ -467,6 +483,63 @@ def cmd_chaos(args: argparse.Namespace) -> int:
             if not ctx.report(step=step, accuracy=(1.0 - 0.2 * (x - 0.05) ** 2) * (step + 1) / 3):
                 return
 
+    # --wedge-device scenario: a sharded trial-axis mesh over the visible
+    # (virtual CPU) devices + a cohort-capable twin of the toy trainer, so
+    # the injected device fault hits a real vmap cohort and must recover
+    # through elastic degradation (narrower mesh -> vmap -> serial)
+    mesh = None
+    preflight_report = None
+    if wedge_devices:
+        # best-effort: only effective when jax has not initialized yet
+        os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        import jax
+
+        from katib_tpu.parallel.mesh import TRIAL_AXIS, make_mesh
+        from katib_tpu.utils import meshhealth
+
+        devs = jax.devices()
+        if len(devs) < 2:
+            print(
+                "chaos --wedge-device needs >= 2 devices; launch with "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=8",
+                file=sys.stderr,
+            )
+            return 2
+        t = min(4, len(devs))
+        mesh = make_mesh({TRIAL_AXIS: t}, devices=devs[:t])
+        # doctor-detection assertion input: the bounded probe must classify
+        # the injector-wedged devices as wedged before the sweep starts
+        preflight_report = meshhealth.probe_devices(
+            devs[:t], deadline=10.0, injector=injector
+        )
+
+        def cohort_trainer(cctx):
+            # checkpoint-aware twin of `trainer`: same progress markers per
+            # member, metric rows stacked [K]
+            starts = []
+            for d in cctx.checkpoint_dirs:
+                os.makedirs(d, exist_ok=True)
+                m = os.path.join(d, "progress.txt")
+                s = 0
+                if os.path.exists(m):
+                    with open(m) as f:
+                        s = int(f.read().strip() or 0)
+                starts.append(s)
+            xs = [float(p["lr"]) for p in cctx.params_list]
+            for step in range(min(starts), 3):
+                for d in cctx.checkpoint_dirs:
+                    with open(os.path.join(d, "progress.txt"), "w") as f:
+                        f.write(str(step + 1))
+                rows = [
+                    (1.0 - 0.2 * (x - 0.05) ** 2) * (step + 1) / 3 for x in xs
+                ]
+                if not cctx.report(step=step, accuracy=rows):
+                    return
+
+        from katib_tpu.runner.cohort import attach_cohort_fn
+
+        attach_cohort_fn(trainer, cohort_trainer)
+
     spec = ExperimentSpec(
         name="chaos-random",
         algorithm=AlgorithmSpec(name="random", settings={"seed": str(args.seed)}),
@@ -477,7 +550,12 @@ def cmd_chaos(args: argparse.Namespace) -> int:
             ParameterSpec("lr", ParameterType.DOUBLE, FeasibleSpace(min=0.01, max=0.2)),
         ],
         max_trial_count=args.trials,
-        parallel_trial_count=1,  # keeps injector trial indices deterministic
+        # cohort members count against the parallel budget: the wedge
+        # scenario needs a full cohort in one batch, everything else keeps
+        # 1 so injector trial indices stay deterministic
+        parallel_trial_count=(
+            min(4, args.trials) if wedge_devices else 1
+        ),
         max_retries=args.max_retries,
         retry_backoff_seconds=0.05,
         suggester_max_errors=args.suggester_max_errors,
@@ -485,6 +563,10 @@ def cmd_chaos(args: argparse.Namespace) -> int:
         # the scenario injects hangs so the happy path stays unchanged
         progress_deadline_seconds=(
             args.progress_deadline if args.hang_trial else None
+        ),
+        # compile watchdog only arms for the --compile-hang scenario
+        compile_deadline_seconds=(
+            args.compile_deadline if args.compile_hang else None
         ),
         drain_grace_seconds=args.drain_grace,
         # the preempt scenario spans two orchestrator lifetimes; a resumable
@@ -500,10 +582,12 @@ def cmd_chaos(args: argparse.Namespace) -> int:
     errors_before = obs.suggester_errors.get(algorithm="random")
     retried_before = obs.trials_retried.get(kind=FailureKind.TRANSIENT.value)
     hangs_before = obs.trial_hangs.get()
+    compile_hangs_before = obs.compile_hangs.get()
+    degraded_before = obs.mesh_degraded.get()
     preempted = False
     completed_at_drain: set[str] = set()
     with tempfile.TemporaryDirectory(prefix="katib-chaos-") as workdir:
-        orch = Orchestrator(workdir=workdir, fault_injector=injector)
+        orch = Orchestrator(workdir=workdir, mesh=mesh, fault_injector=injector)
         if args.preempt_at is not None:
             # the injected preempt delivers a real SIGTERM to this process:
             # install the same drain handlers `katib-tpu run` uses so the
@@ -529,7 +613,7 @@ def cmd_chaos(args: argparse.Namespace) -> int:
             )
             # fresh orchestrator = new process semantics: everything it knows
             # must come from the journal + suggester pickle, not live memory
-            orch = Orchestrator(workdir=workdir, fault_injector=injector)
+            orch = Orchestrator(workdir=workdir, mesh=mesh, fault_injector=injector)
             _install_drain_handlers(orch)
             exp = orch.run(spec, experiment=orch.load_experiment(spec))
 
@@ -543,7 +627,9 @@ def cmd_chaos(args: argparse.Namespace) -> int:
         f"injected: {len(injector.log)} faults; "
         f"retries={obs.trials_retried.get(kind=FailureKind.TRANSIENT.value) - retried_before:g}; "
         f"suggester errors absorbed={obs.suggester_errors.get(algorithm='random') - errors_before:g}; "
-        f"hangs caught={obs.trial_hangs.get() - hangs_before:g}"
+        f"hangs caught={obs.trial_hangs.get() - hangs_before:g}; "
+        f"compile hangs caught={obs.compile_hangs.get() - compile_hangs_before:g}; "
+        f"mesh degradations={obs.mesh_degraded.get() - degraded_before:g}"
     )
 
     failures = []
@@ -564,6 +650,57 @@ def cmd_chaos(args: argparse.Namespace) -> int:
             failures.append(
                 "hung trial did not recover on retry: "
                 f"{[(t.name, t.condition.value) for t in hung]}"
+            )
+    if args.compile_hang:
+        if obs.compile_hangs.get() - compile_hangs_before <= 0:
+            failures.append(
+                "injected compile hang was never caught by the compile watchdog"
+            )
+        else:
+            compile_hung = [
+                t
+                for t in exp.trials.values()
+                if t.failure_kind == FailureKind.COMPILE_HANG.value
+                and t.retry_count > 0
+            ]
+            if not compile_hung:
+                failures.append(
+                    "no trial journaled failure_kind=CompileHang with a retry"
+                )
+            elif not all(
+                t.condition is TrialCondition.SUCCEEDED for t in compile_hung
+            ):
+                failures.append(
+                    "compile-hung trial did not recover on retry: "
+                    f"{[(t.name, t.condition.value) for t in compile_hung]}"
+                )
+    if wedge_devices:
+        wedged_seen = {
+            d.device for d in preflight_report.devices if d.status == "wedged"
+        }
+        if preflight_report.ok() or not wedged_seen:
+            failures.append(
+                "doctor probe did not classify the injected wedged device(s): "
+                f"{preflight_report.summary()}"
+            )
+        if not any(e.get("seam") == "cohort-device" for e in injector.log):
+            failures.append(
+                "wedged device never intersected a cohort mesh "
+                "(sharded cohort path was not exercised)"
+            )
+        if obs.mesh_degraded.get() - degraded_before <= 0:
+            failures.append(
+                "device fault did not trigger elastic mesh degradation"
+            )
+        not_completed = [
+            t.name
+            for t in exp.trials.values()
+            if t.condition is not TrialCondition.SUCCEEDED
+        ]
+        if not_completed:
+            failures.append(
+                "trials lost to the device fault (elastic degradation should "
+                f"complete all of them): {not_completed}"
             )
     if args.preempt_at is not None:
         if not preempted:
@@ -810,54 +947,32 @@ def cmd_ui(args: argparse.Namespace) -> int:
 
 
 def cmd_doctor(args: argparse.Namespace) -> int:
+    """Bounded-time device preflight: probe every visible device with a tiny
+    jitted program in a killable CHILD process (on a wedged accelerator pool
+    even ``jax.devices()`` blocks forever, and a diagnostic tool that hangs
+    is worse than the condition it diagnoses).  Exit 0 only when every
+    enumerated device ran the probe within the deadline."""
+    from katib_tpu.utils import meshhealth
+
+    report = meshhealth.doctor_report(
+        deadline=float(args.device_timeout),
+        simulate_wedge=args.simulate_wedge or None,
+    )
+    if args.json:
+        print(report.to_json())
+        return 0 if report.ok() else 1
+
+    print(report.summary())
+    for d in sorted(report.devices, key=lambda d: d.device):
+        line = f"  {d.device:<12} {d.status:<8} probe={d.probe_seconds:.2f}s"
+        if d.error:
+            line += f"  ({d.error})"
+        print(line)
+    if report.error:
+        print(f"  error: {report.error}")
+
     from katib_tpu.native import build_error, native_available
 
-    # device init runs in a killable CHILD with a deadline: on a wedged
-    # accelerator pool (stale grant) ``jax.devices()`` blocks forever, and a
-    # diagnostic tool that hangs is worse than the condition it diagnoses
-    import subprocess
-
-    probe = (
-        "import json, os, time, jax\n"
-        # the axon PJRT plugin registers at interpreter boot and ignores
-        # JAX_PLATFORMS; honor it explicitly so JAX_PLATFORMS=cpu probes CPU
-        "want = os.environ.get('JAX_PLATFORMS')\n"
-        "jax.config.update('jax_platforms', want) if want else None\n"
-        "t0 = time.time(); d = jax.devices()\n"
-        "print(json.dumps({'n': len(d), 'platform': d[0].platform,"
-        " 'init_secs': round(time.time() - t0, 1)}))\n"
-    )
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c", probe],
-            capture_output=True,
-            text=True,
-            timeout=float(args.device_timeout),
-        )
-        info = None
-        if out.returncode == 0:
-            # a degraded environment may print banners around the JSON line;
-            # a parse failure is a diagnosis, not a doctor crash
-            lines = (out.stdout or "").strip().splitlines()
-            try:
-                info = json.loads(lines[-1]) if lines else None
-            except ValueError:
-                info = None
-        if info:
-            print(
-                f"devices: {info['n']} x {info['platform']} "
-                f"(init {info['init_secs']}s)"
-            )
-        else:
-            tail = (out.stderr or "").strip().splitlines()
-            print(f"devices: init failed rc={out.returncode}"
-                  + (f" ({tail[-1]})" if tail else ""))
-    except subprocess.TimeoutExpired:
-        print(
-            f"devices: init blocked > {args.device_timeout}s — accelerator "
-            "pool wedged (stale grant?); CPU-only work is unaffected, TPU "
-            "runs will recover when the pool releases the grant"
-        )
     import jax
 
     print(f"jax {jax.__version__}")
@@ -868,7 +983,7 @@ def cmd_doctor(args: argparse.Namespace) -> int:
     cfg = KatibConfig.load(args.config)
     print(f"workdir: {cfg.init.workdir}")
     print(f"store: {cfg.store.backend}")
-    return 0
+    return 0 if report.ok() else 1
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -893,6 +1008,12 @@ def main(argv: list[str] | None = None) -> int:
         help="on SIGTERM/SIGINT, wait this long for running trials to reach "
         "a checkpoint boundary before journaling them Drained "
         "(overrides the spec's drainGraceSeconds)",
+    )
+    p.add_argument(
+        "--no-preflight",
+        action="store_true",
+        help="skip the bounded device preflight probe that gates the run "
+        "(KATIB_PREFLIGHT_DEADLINE bounds it; see `katib-tpu doctor`)",
     )
     p.set_defaults(fn=cmd_run)
 
@@ -988,10 +1109,34 @@ def main(argv: list[str] | None = None) -> int:
         "(drain -> journal -> in-process resume, asserting zero lost trials)",
     )
     p.add_argument(
+        "--compile-hang",
+        action="append",
+        metavar="K[:J]",
+        help="wedge trial K's attempt J (default 1) before its first report, "
+        "inside the compile budget, until the compile watchdog interrupts "
+        "it; repeatable",
+    )
+    p.add_argument(
+        "--wedge-device",
+        action="append",
+        type=int,
+        metavar="N",
+        help="wedge device id N: the preflight probe classifies it wedged "
+        "and any sharded cohort whose mesh contains it takes a DEVICE "
+        "fault, asserting elastic degradation completes every trial; "
+        "repeatable",
+    )
+    p.add_argument(
         "--progress-deadline",
         type=float,
         default=0.75,
         help="progressDeadlineSeconds used when --hang-trial is given",
+    )
+    p.add_argument(
+        "--compile-deadline",
+        type=float,
+        default=0.5,
+        help="compileDeadlineSeconds used when --compile-hang is given",
     )
     p.add_argument(
         "--drain-grace",
@@ -1037,12 +1182,30 @@ def main(argv: list[str] | None = None) -> int:
     )
     p.set_defaults(fn=cmd_ui)
 
-    p = sub.add_parser("doctor", help="environment report")
+    p = sub.add_parser(
+        "doctor",
+        help="bounded-time device preflight + environment report "
+        "(exit 0 = every device healthy)",
+    )
     p.add_argument(
         "--device-timeout",
         default=30.0,
         type=float,
-        help="seconds to wait for device init before declaring the pool wedged",
+        help="seconds to wait for device enumeration + probes before "
+        "declaring the pool wedged",
+    )
+    p.add_argument(
+        "--json",
+        action="store_true",
+        help="print the machine-readable per-device health report only",
+    )
+    p.add_argument(
+        "--simulate-wedge",
+        action="append",
+        type=int,
+        metavar="N",
+        help="treat device id N as wedged (testing the non-zero exit path); "
+        "repeatable",
     )
     p.set_defaults(fn=cmd_doctor)
 

@@ -555,6 +555,12 @@ class TrialSpec:
     # monitor thread interrupts a train_fn wedged BETWEEN reports (stuck
     # compile, deadlocked collective).  None = disabled.
     progress_deadline_seconds: float | None = None
+    # compile watchdog: budget for jit compile + FIRST dispatch (trace to
+    # first ctx.report()).  The progress watchdog only arms per-step cadence;
+    # a 470s live compile (BENCH_r05) is indistinguishable from a wedge
+    # without a separate budget.  Overruns classify as the retryable
+    # FailureKind.COMPILE_HANG.  None = disabled.
+    compile_deadline_seconds: float | None = None
 
     def params(self) -> dict[str, Any]:
         return assignments_to_dict(self.assignments)
@@ -682,6 +688,11 @@ class ExperimentSpec:
     # trials get this long to checkpoint-and-exit at a step boundary before
     # being hard-killed (still journaled Drained, so resume re-runs them).
     drain_grace_seconds: float = 30.0
+    # Compile watchdog: fail a trial FailureKind.COMPILE_HANG (retryable)
+    # when its jit compile + first dispatch exceed this budget — propagated
+    # into every TrialSpec (see TrialSpec.compile_deadline_seconds).
+    # None = disabled.
+    compile_deadline_seconds: float | None = None
 
     def parameter(self, name: str) -> ParameterSpec:
         for p in self.parameters:

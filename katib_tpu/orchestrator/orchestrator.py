@@ -73,6 +73,7 @@ class Orchestrator:
         config=None,
         slice_allocator=None,
         fault_injector: faults.FaultInjector | None = None,
+        preflight: bool | None = None,
     ):
         self.store = store if store is not None else MemoryObservationStore()
         # a defaulted store may be upgraded to the durable sqlite backend at
@@ -94,6 +95,15 @@ class Orchestrator:
         # through the suggester call and every trial attempt so tests and
         # `katib-tpu chaos` exercise the recovery paths on demand
         self.fault_injector = fault_injector
+        # device preflight gate (utils.meshhealth): probe every visible
+        # device under a deadline before opening the trial pool, so a wedged
+        # accelerator pool fails the experiment fast with a per-device
+        # health report instead of hanging in the first compile.  Explicit
+        # argument wins; else opt-in via KATIB_PREFLIGHT=1 (the CLI `run`
+        # verb enables it by default, library embedding stays opt-in).
+        if preflight is None:
+            preflight = os.environ.get("KATIB_PREFLIGHT") == "1"
+        self.preflight = bool(preflight)
         # jax.profiler is a process-global singleton; only one trial may
         # trace at a time — others run unprofiled rather than crash
         self._profile_lock = threading.Lock()
@@ -274,6 +284,22 @@ class Orchestrator:
             exp.update_optimal()
             self._finish(exp)
             raise
+
+        # device preflight gate: a wedged pool fails the experiment FAST
+        # (terminal + journaled machine-readable report) instead of hanging
+        # in the first trial's compile.  Runs after tracer activation so the
+        # "preflight" span lands in the trace journal.
+        if self.preflight:
+            from katib_tpu.utils import meshhealth
+
+            report = meshhealth.preflight(injector=self.fault_injector)
+            if not report.ok():
+                exp.condition = ExperimentCondition.FAILED
+                exp.message = "device preflight failed: " + report.summary()
+                exp.completion_time = time.time()
+                exp.update_optimal()
+                self._finish(exp)
+                raise RuntimeError(exp.message)
 
         with cf.ThreadPoolExecutor(
             max_workers=spec.parallel_trial_count, thread_name_prefix=f"trial-{exp.name}"
@@ -480,6 +506,7 @@ class Orchestrator:
                 max_retries=exp.spec.max_retries,
                 retry_backoff_seconds=exp.spec.retry_backoff_seconds,
                 progress_deadline_seconds=exp.spec.progress_deadline_seconds,
+                compile_deadline_seconds=exp.spec.compile_deadline_seconds,
             ),
             condition=TrialCondition.RUNNING,
             start_time=time.time(),

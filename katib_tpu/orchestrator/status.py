@@ -87,8 +87,17 @@ def experiment_to_dict(exp: Experiment) -> dict:
         ),
         # best-objective@wallclock rows (the BASELINE driver metric)
         "optimal_history": list(exp.optimal_history),
+        # last device-preflight verdict of this process (utils/meshhealth):
+        # None until a preflight/doctor probe has run
+        "device_health": _device_health(),
         "trials": {name: trial_to_dict(t) for name, t in exp.trials.items()},
     }
+
+
+def _device_health() -> dict | None:
+    from katib_tpu.utils.meshhealth import last_report_dict
+
+    return last_report_dict()
 
 
 def write_status(exp: Experiment, workdir: str) -> str:

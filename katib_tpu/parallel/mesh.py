@@ -143,6 +143,32 @@ def serial_mesh(mesh: Mesh | None) -> Mesh | None:
     return mesh
 
 
+def narrowed_trial_mesh(mesh: Mesh | None, survivors: Sequence[Any]) -> Mesh | None:
+    """Rebuild ``mesh`` over the surviving devices after a device fault,
+    shrinking only the ``trial`` axis (elastic cohort degradation).
+
+    Non-trial axes keep their sizes — a tensor-parallel layout cannot shrink
+    without resharding parameters — so the trial axis becomes
+    ``len(survivors) // prod(other axes)`` and any leftover survivors are
+    dropped to keep the grid rectangular.  Axis order is preserved.  Returns
+    ``None`` when no strictly narrower mesh exists (no mesh, no trial axis,
+    or too few survivors for even one trial row) — callers then degrade to
+    the single-device vmap tier (``mesh=None``).
+    """
+    if mesh is None or TRIAL_AXIS not in mesh.shape:
+        return None
+    old_t = mesh.shape[TRIAL_AXIS]
+    other = math.prod(s for name, s in mesh.shape.items() if name != TRIAL_AXIS)
+    new_t = len(survivors) // other
+    if new_t < 1 or new_t >= old_t:
+        return None
+    sizes = {
+        name: (new_t if name == TRIAL_AXIS else mesh.shape[name])
+        for name in mesh.axis_names
+    }
+    return make_mesh(sizes, devices=list(survivors)[: new_t * other])
+
+
 def needs_safe_conv(mesh: Mesh | None) -> bool:
     """True when grouped-convolution gradients cannot be trusted on this
     mesh: XLA's SPMD partitioner miscompiles grouped-conv filter gradients

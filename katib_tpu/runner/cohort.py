@@ -389,33 +389,112 @@ def run_cohort(
             min(deadlines),
             on_hang=lambda _name: hang_event.set(),
         )
-    ctx = CohortContext(
-        survivors, store, objective, mesh=mesh, stop_event=stop_event,
-        drain_event=drain_event, hang_event=hang_event,
-        heartbeat=heartbeat.beat if heartbeat is not None else None,
-    )
-    devices = ctx.trial_devices
+    # compile watchdog: one budget for the cohort's shared trace/compile/first
+    # dispatch, disarmed by the first step-boundary beat.  Re-armed per
+    # degradation tier (a rebuilt mesh means a fresh compile).
+    compile_hang_event = threading.Event()
+    compile_deadlines = [
+        t.spec.compile_deadline_seconds
+        for t in survivors
+        if t.spec.compile_deadline_seconds
+    ]
+    compile_hb_holder: list = [None]
+
+    def _on_compile_hang(_name: str) -> None:
+        obs.compile_hangs.inc()
+        compile_hang_event.set()
+        hang_event.set()  # cooperative unwind through the hang path
+
+    def _beat() -> None:
+        hb = compile_hb_holder[0]
+        if hb is not None:
+            # first step-boundary report = first dispatch done
+            hb.close()
+            compile_hb_holder[0] = None
+        if heartbeat is not None:
+            heartbeat.beat()
+
+    # elastic degradation: a DEVICE-classified cohort failure probes the
+    # mesh, rebuilds it from survivors with a narrower trial axis, and
+    # re-runs the cohort (members resume from their checkpoints).  The loop
+    # terminates because each pass strictly shrinks the trial axis — the
+    # final tier is mesh=None (single-device vmap); anything past that falls
+    # back to serial per-member execution.
+    from katib_tpu.parallel.mesh import trial_axis_size
+
+    cur_mesh = mesh
     started = time.perf_counter()
+    tier = 0
     try:
-        with tracing.span(
-            "cohort",
-            size=k,
-            key=key,
-            devices=devices,
-            members_per_device=ctx.padded_size // devices,
-        ):
-            cohort_fn(ctx)
-    except Exception:
-        # the vectorized path is an optimization, never a correctness
-        # dependency: re-run every member serially (duplicate metric rows
-        # from the partial cohort are tolerated by the store's reduction)
-        obs.cohort_fallbacks.inc()
-        for t in survivors:
-            results[t.name] = run_trial(
-                t, store, objective, mesh, stop_event,
-                watchdog=watchdog, drain_event=drain_event,
+        while True:
+            ctx = CohortContext(
+                survivors, store, objective, mesh=cur_mesh, stop_event=stop_event,
+                drain_event=drain_event, hang_event=hang_event,
+                heartbeat=(
+                    _beat if (heartbeat is not None or compile_deadlines) else None
+                ),
             )
-        return results
+            devices = ctx.trial_devices
+            if watchdog is not None and compile_deadlines:
+                compile_hb_holder[0] = watchdog.register(
+                    f"compile:cohort:{key or survivors[0].name}",
+                    min(compile_deadlines),
+                    on_hang=_on_compile_hang,
+                )
+            try:
+                if injector is not None and cur_mesh is not None:
+                    injector.on_cohort_execute(
+                        survivors, [d.id for d in cur_mesh.devices.flat]
+                    )
+                with tracing.span(
+                    "cohort",
+                    size=k,
+                    key=key,
+                    devices=devices,
+                    members_per_device=ctx.padded_size // devices,
+                    tier=tier,
+                ):
+                    cohort_fn(ctx)
+                break
+            except Exception as e:
+                kind = classify_exception(e)
+                if kind is FailureKind.DEVICE and trial_axis_size(cur_mesh) > 1:
+                    from katib_tpu.parallel.mesh import narrowed_trial_mesh
+                    from katib_tpu.utils import meshhealth
+
+                    devs = list(cur_mesh.devices.flat)
+                    report = meshhealth.probe_devices(
+                        devs,
+                        deadline=min(10.0, meshhealth.default_deadline()),
+                        injector=injector,
+                    )
+                    for d in report.devices:
+                        obs.device_healthy.set(
+                            1.0 if d.status == meshhealth.HEALTHY else 0.0,
+                            device=d.device,
+                            platform=d.platform,
+                        )
+                    alive_devs = meshhealth.healthy_devices(devs, report)
+                    cur_mesh = narrowed_trial_mesh(cur_mesh, alive_devs)
+                    obs.mesh_degraded.inc()
+                    tier += 1
+                    continue  # retry: narrower sharded mesh, or vmap when None
+                # the vectorized path is an optimization, never a correctness
+                # dependency: re-run every member serially (duplicate metric
+                # rows from the partial cohort are tolerated by the store's
+                # reduction)
+                obs.cohort_fallbacks.inc()
+                for t in survivors:
+                    results[t.name] = run_trial(
+                        t, store, objective, None, stop_event,
+                        watchdog=watchdog, drain_event=drain_event,
+                    )
+                return results
+            finally:
+                hb = compile_hb_holder[0]
+                if hb is not None:
+                    hb.close()
+                    compile_hb_holder[0] = None
     finally:
         if heartbeat is not None:
             heartbeat.close()
@@ -427,7 +506,20 @@ def run_cohort(
     obs.cohort_devices.set(float(devices))
     per_member = elapsed / k
     for i, t in enumerate(survivors):
-        results[t.name] = ctx._settle(i)
+        member_result = ctx._settle(i)
+        if (
+            compile_hang_event.is_set()
+            and member_result.failure_kind is FailureKind.HANG
+        ):
+            # the hang the watchdog flagged was the compile budget, not
+            # step-progress: reclassify so retry telemetry stays honest
+            member_result = TrialResult(
+                TrialCondition.FAILED,
+                "compile watchdog: cohort jit compile / first dispatch "
+                "exceeded compileDeadlineSeconds",
+                failure_kind=FailureKind.COMPILE_HANG,
+            )
+        results[t.name] = member_result
         # per-member span so trial-level trace analysis (and the CI
         # observability smoke) sees cohort members as ordinary trials
         tracing.record_span(

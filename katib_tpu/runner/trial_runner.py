@@ -40,6 +40,7 @@ from katib_tpu.earlystop.rules import RuleEvaluator
 from katib_tpu.runner.context import TrialContext, TrialEarlyStopped
 from katib_tpu.runner.metrics import parse_json_lines, parse_text_lines_fast
 from katib_tpu.store.base import ObservationStore
+from katib_tpu.utils import observability as obs
 from katib_tpu.utils import tracing
 from katib_tpu.utils.faults import (
     FailureKind,
@@ -192,6 +193,7 @@ def _run_whitebox(
     drain_event: threading.Event | None = None,
 ) -> TrialResult:
     hang_event = threading.Event()
+    compile_hang_event = threading.Event()
     heartbeat = None
     if watchdog is not None and trial.spec.progress_deadline_seconds:
         heartbeat = watchdog.register(
@@ -199,6 +201,31 @@ def _run_whitebox(
             trial.spec.progress_deadline_seconds,
             on_hang=lambda _name: hang_event.set(),
         )
+    # compile watchdog: the progress watchdog only measures step-to-step
+    # cadence, so a jit compile (or first dispatch) that never completes
+    # looks identical to a wedge.  Arm a one-shot budget that covers trace
+    # -> compile -> first ctx.report(); the first beat disarms it.
+    compile_hb = None
+    if watchdog is not None and trial.spec.compile_deadline_seconds:
+
+        def _on_compile_hang(_name: str) -> None:
+            obs.compile_hangs.inc()
+            compile_hang_event.set()
+            hang_event.set()  # reuse the cooperative hang unwind path
+
+        compile_hb = watchdog.register(
+            f"compile:{trial.name}",
+            trial.spec.compile_deadline_seconds,
+            on_hang=_on_compile_hang,
+        )
+
+    def _beat() -> None:
+        if compile_hb is not None:
+            # first metric report = first dispatch completed: compile is done
+            compile_hb.close()
+        if heartbeat is not None:
+            heartbeat.beat()
+
     ctx = TrialContext(
         trial_name=trial.name,
         params=trial.params(),
@@ -211,7 +238,7 @@ def _run_whitebox(
         max_runtime_seconds=trial.spec.max_runtime_seconds,
         drain_event=drain_event,
         hang_event=hang_event,
-        heartbeat=heartbeat.beat if heartbeat is not None else None,
+        heartbeat=_beat if (heartbeat is not None or compile_hb is not None) else None,
     )
 
     def _deadline_result() -> TrialResult:
@@ -224,6 +251,13 @@ def _run_whitebox(
         )
 
     def _hang_result() -> TrialResult:
+        if compile_hang_event.is_set():
+            return TrialResult(
+                TrialCondition.FAILED,
+                "compile watchdog: jit compile / first dispatch exceeded "
+                f"compileDeadlineSeconds={trial.spec.compile_deadline_seconds}",
+                failure_kind=FailureKind.COMPILE_HANG,
+            )
         return TrialResult(
             TrialCondition.FAILED,
             "hang watchdog: no progress for "
@@ -233,7 +267,13 @@ def _run_whitebox(
 
     try:
         if injector is not None:
-            # chaos 'hang' action: wedge here like a stuck compile; only the
+            # chaos 'compile-hang' action: wedge *before* the first report,
+            # inside the compile budget — only the compile watchdog (or
+            # stop/drain) can unwedge it
+            injector.maybe_compile_hang(
+                trial, events=(compile_hang_event, hang_event, stop_event, drain_event)
+            )
+            # chaos 'hang' action: wedge here like a stuck step; only the
             # watchdog / stop / drain machinery can unwedge it — and whichever
             # did decides the settlement (HANG / KILLED / DRAINED)
             injector.maybe_hang(trial, events=(hang_event, stop_event, drain_event))
@@ -259,6 +299,8 @@ def _run_whitebox(
             failure_kind=classify_exception(e),
         )
     finally:
+        if compile_hb is not None:
+            compile_hb.close()
         if heartbeat is not None:
             heartbeat.close()
     if evaluator.should_stop():

@@ -285,8 +285,15 @@ class UiServer:
         aggregates) — the dashboard's counter strip reads this instead of
         scraping the Prometheus endpoint separately."""
         from katib_tpu.utils.observability import REGISTRY
+        from katib_tpu.utils.meshhealth import last_report_dict
 
-        return 200, {"workdir": self.workdir, "metrics": REGISTRY.snapshot()}
+        return 200, {
+            "workdir": self.workdir,
+            "metrics": REGISTRY.snapshot(),
+            # last device-preflight verdict of this process (None until a
+            # doctor/preflight probe ran) — per-device health rows
+            "device_health": last_report_dict(),
+        }
 
     def experiment(self, name: str):
         status = read_status(self.workdir, name)
@@ -601,6 +608,11 @@ async function counters(){
   const tot=n=>m[n]?m[n].total:0;
   const dur=m['katib_trial_duration_seconds'];
   const mean=dur&&dur.total?(dur.samples.reduce((a,x)=>a+x.sum,0)/dur.total):null;
+  // device-health strip: the per-device preflight gauge (1 healthy / 0
+  // wedged-or-absent); absent until a doctor/preflight probe ran in-process
+  const dh=m['katib_device_healthy'];
+  const dhUp=dh?dh.samples.filter(x=>x.value>0).length:0;
+  const dhAll=dh?dh.samples.length:0;
   document.getElementById('counters').innerHTML=
     `<small>trials: ${tot('katib_trial_created_total')} created · `+
     `${tot('katib_trial_succeeded_total')} succeeded · `+
@@ -608,6 +620,9 @@ async function counters(){
     `${tot('katib_trial_retried_total')} retried · `+
     `${tot('katib_trial_early_stopped_total')} early-stopped · `+
     `experiments running: ${tot('katib_experiments_current')}`+
+    (dhAll?` · devices: ${dhUp}/${dhAll} healthy${dhUp<dhAll?' <b>POOL DEGRADED</b>':''}`:'')+
+    (tot('katib_mesh_degraded_total')?` · mesh degradations: ${tot('katib_mesh_degraded_total')}`:'')+
+    (tot('katib_compile_hangs_total')?` · compile hangs: ${tot('katib_compile_hangs_total')}`:'')+
     (tot('katib_trial_hangs_total')?` · hangs caught: ${tot('katib_trial_hangs_total')}`:'')+
     (tot('katib_checkpoint_fallback_total')?` · ckpt fallbacks: ${tot('katib_checkpoint_fallback_total')}`:'')+
     (tot('katib_drain_requested')?' · <b>DRAINING</b>':'')+

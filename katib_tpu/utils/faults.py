@@ -55,12 +55,26 @@ class FailureKind(str, enum.Enum):
     # compile or deadlocked collective usually clears on a re-run from the
     # last checkpoint, unlike a deterministic shape bug.
     HANG = "Hang"
+    # device/mesh-layer fault: a wedged or vanished accelerator under a
+    # running trial/cohort (utils/meshhealth.py classifies the pool, the
+    # cohort engine degrades onto survivors).  Retryable: the re-run lands
+    # on a rebuilt mesh or the serial fallback, not the dead chip.
+    DEVICE = "Device"
+    # jit compile / first dispatch exceeded compileDeadlineSeconds (the
+    # compile watchdog in runner/trial_runner.py).  Retryable: a warm
+    # compile cache or a recovered pool usually clears it.
+    COMPILE_HANG = "CompileHang"
 
     @property
     def retryable(self) -> bool:
         """Whether the orchestrator's bounded retry loop should re-run the
         attempt (same trial name + checkpoint dir)."""
-        return self in (FailureKind.TRANSIENT, FailureKind.HANG)
+        return self in (
+            FailureKind.TRANSIENT,
+            FailureKind.HANG,
+            FailureKind.DEVICE,
+            FailureKind.COMPILE_HANG,
+        )
 
 
 # Infrastructure-failure markers inside exception text / tracebacks.  TPU
@@ -79,6 +93,20 @@ _TRANSIENT_MARKERS = (
     "temporarily",  # EAGAIN-style "resource temporarily unavailable"
     "device or resource busy",
     "injected transient",  # FaultInjector tracebacks classify like the real thing
+)
+
+# Device/mesh-layer markers: a chip dying or vanishing under a running
+# program.  Checked before the transient markers — a dead device needs the
+# mesh-rebuild path (elastic cohort degradation), not a blind same-mesh
+# re-run.  libtpu/PJRT surface these as XlaRuntimeError text, like the
+# transient family.
+_DEVICE_MARKERS = (
+    "device is in an invalid state",
+    "device not found",
+    "device disappeared",
+    "chip has been disabled",
+    "slice health",
+    "injected device",  # FaultInjector device wedges classify like the real thing
 )
 
 # Exception families with an unambiguous kind.  Checked before the text
@@ -112,6 +140,8 @@ RETRYABLE_EXIT_CODES = frozenset({75, 128 + 6, 128 + 9, 128 + 15})
 
 def _classify_text(text: str) -> FailureKind:
     low = text.lower()
+    if any(marker in low for marker in _DEVICE_MARKERS):
+        return FailureKind.DEVICE
     if any(marker in low for marker in _TRANSIENT_MARKERS):
         return FailureKind.TRANSIENT
     return FailureKind.PERMANENT
@@ -134,6 +164,8 @@ def classify_traceback(text: str) -> FailureKind:
     """Classify from traceback *text* — the whitebox path journals only the
     formatted traceback, and resumed trials have no live exception object."""
     low = text.lower()
+    if any(marker in low for marker in _DEVICE_MARKERS):
+        return FailureKind.DEVICE
     if any(marker in low for marker in _TRANSIENT_MARKERS):
         return FailureKind.TRANSIENT
     for name in (
@@ -311,6 +343,11 @@ class FaultInjector:
       ``progressDeadlineSeconds`` path must catch it);
     - ``preempt_at(k)``          — deliver SIGTERM to this process when
       trial k starts (fires once — exercises the orchestrator drain path);
+    - ``compile_hang(k, j)``     — wedge trial k's attempt j in its compile
+      phase (only the ``compileDeadlineSeconds`` watchdog can settle it);
+    - ``wedge_device(n)``        — mark device id n wedged: the mesh-health
+      prober reports it WEDGED, and cohorts whose mesh contains it raise a
+      DEVICE fault (exercises elastic degradation);
     - ``flake(rate, kind)``      — seeded random per-attempt failures.
 
     The seams (``on_trial_attempt`` / ``on_suggester_call`` /
@@ -329,6 +366,8 @@ class FaultInjector:
         self._corruptions: dict[object, list[int]] = {}
         self._metric_delays: dict[object, float] = {}
         self._hangs: set[tuple[object, int]] = set()
+        self._compile_hangs: set[tuple[object, int]] = set()
+        self._wedged_devices: set[int] = set()
         self._preempts: set[object] = set()
         self._flake_rate = 0.0
         self._flake_kind = FailureKind.TRANSIENT
@@ -360,6 +399,28 @@ class FaultInjector:
         step: the runner's ``maybe_hang`` seam sleeps until an interruption
         event (hang watchdog / stop / drain) is set."""
         self._hangs.add((trial, int(attempt)))
+        return self
+
+    def compile_hang(self, trial, attempt: int = 1):
+        """Wedge trial ``trial``'s attempt ``attempt`` in its *compile/first
+        dispatch* phase: the runner's ``maybe_compile_hang`` seam sleeps
+        until interrupted, so only the compile watchdog
+        (``compileDeadlineSeconds``) can settle it as COMPILE_HANG."""
+        self._compile_hangs.add((trial, int(attempt)))
+        return self
+
+    def wedge_device(self, device_id: int):
+        """Mark device ``device_id`` wedged: ``is_device_wedged`` reports it
+        to the mesh-health prober (doctor / preflight classify it WEDGED
+        without burning wall-clock), and ``on_cohort_execute`` raises a
+        DEVICE fault for any cohort whose mesh still contains it — the
+        deterministic stand-in for a chip dying under a sharded cohort."""
+        self._wedged_devices.add(int(device_id))
+        return self
+
+    def unwedge_device(self, device_id: int):
+        """Clear a wedge (models a pool releasing a stale grant)."""
+        self._wedged_devices.discard(int(device_id))
         return self
 
     def preempt_at(self, trial):
@@ -474,6 +535,56 @@ class FaultInjector:
         live = [e for e in events if e is not None]
         while not any(e.is_set() for e in live):
             time.sleep(poll)
+
+    def maybe_compile_hang(self, trial, events: tuple = (), poll: float = 0.02) -> None:
+        """Runner seam, called where jit compile / first dispatch would run:
+        when a ``compile_hang`` spec matches the current attempt, wedge here
+        until any of ``events`` (compile-watchdog flag, stop, drain) is set
+        — exactly like an XLA compile that never returns.  Fires once per
+        (trial, attempt)."""
+        name = trial.name
+        with self._lock:
+            idx = self._order.get(name)
+            attempt = self._attempts.get(name, 1)
+            key = None
+            for k in self._keys(name, idx):
+                if (k, attempt) in self._compile_hangs:
+                    key = (k, attempt)
+                    break
+            if key is None:
+                return
+            self._compile_hangs.discard(key)
+        self.log.append({"seam": "compile-hang", "trial": name, "attempt": attempt})
+        live = [e for e in events if e is not None]
+        while not any(e.is_set() for e in live):
+            time.sleep(poll)
+
+    def is_device_wedged(self, device_id: int) -> bool:
+        """Prober seam (``utils.meshhealth``): True when ``wedge_device``
+        marked this device id — the probe classifies it WEDGED immediately
+        instead of sleeping out the real deadline."""
+        with self._lock:
+            wedged = int(device_id) in self._wedged_devices
+        if wedged:
+            self.log.append({"seam": "device-probe", "device": int(device_id)})
+        return wedged
+
+    def on_cohort_execute(self, trials, device_ids) -> None:
+        """Cohort seam (``runner/cohort.py``), called just before the
+        vectorized program executes with the mesh's device ids: a mesh that
+        still contains a wedged device raises a DEVICE fault — the elastic
+        degradation path must rebuild the mesh from survivors and re-run."""
+        with self._lock:
+            hit = sorted(self._wedged_devices.intersection(int(d) for d in device_ids))
+        if not hit:
+            return
+        names = [t.name for t in trials]
+        self.log.append({"seam": "cohort-device", "devices": hit, "trials": names})
+        raise InjectedFault(
+            f"injected device fault: wedged device(s) {hit} in cohort mesh "
+            f"(members: {', '.join(names)})",
+            FailureKind.DEVICE,
+        )
 
     def _corrupt_step(self, checkpoint_dir: str | None, step: int, name: str) -> None:
         if not checkpoint_dir:

@@ -455,6 +455,25 @@ checkpoint_fallbacks = REGISTRY.counter(
     "(quarantined; an older verifiable step was used instead)",
 )
 
+# -- device-layer fault tolerance (utils/meshhealth.py, elastic cohorts) ------
+
+device_healthy = REGISTRY.gauge(
+    "katib_device_healthy",
+    "Per-device preflight verdict: 1 healthy, 0 wedged/absent "
+    "(device/platform labels; set by katib-tpu doctor and the run/bench "
+    "preflight)",
+)
+mesh_degraded = REGISTRY.counter(
+    "katib_mesh_degraded_total",
+    "Elastic cohort degradations after a device fault "
+    "(sharded -> narrower mesh -> single-device vmap -> serial)",
+)
+compile_hangs = REGISTRY.counter(
+    "katib_compile_hangs_total",
+    "Trials whose jit compile / first dispatch overran "
+    "compileDeadlineSeconds (classified retryable CompileHang)",
+)
+
 
 def record_device_memory(registry_gauge: _Metric | None = None) -> None:
     """Best-effort per-device memory gauges via ``Device.memory_stats()``

@@ -22,6 +22,13 @@ resulting failure classifies as :class:`~katib_tpu.utils.faults.FailureKind`
 ``HANG`` — retryable, so the orchestrator's PR-2 retry machinery re-runs the
 trial from its last checkpoint.
 
+The same registry also arms the *compile* watchdog: the white-box runner and
+``run_cohort`` register a second, one-shot heartbeat named ``compile:<name>``
+with ``compile_deadline_seconds`` that is closed on the first ``beat()``
+(first dispatch completed).  If it fires instead, the trial settles as the
+retryable ``FailureKind.COMPILE_HANG`` — a stuck XLA compile is otherwise
+indistinguishable from a wedged device.
+
 Stdlib-only (no jax) and clock-injectable for deterministic tests.
 """
 

@@ -266,8 +266,9 @@ spec:
         rc = main(["doctor", "--device-timeout", "60"])
         out = capsys.readouterr().out
         assert rc == 0
-        # conftest forces the 8-device virtual CPU mesh
-        assert "x cpu (init" in out
+        # per-device health report over the virtual CPU pool
+        assert "pool healthy" in out
+        assert "cpu:0" in out
         assert "native runtime" in out
 
     def test_run_without_command_errors(self, tmp_path, capsys):

@@ -1,0 +1,451 @@
+"""Device-layer fault tolerance: mesh health gate, compile watchdog, and
+elastic cohort degradation.
+
+Covers the PR's acceptance properties:
+- bounded-time device probing classifies healthy / wedged / absent without
+  burning the full deadline on injected wedges,
+- ``narrowed_trial_mesh`` shrinks only the trial axis and never widens,
+- a stuck compile settles as the retryable ``FailureKind.COMPILE_HANG``
+  (and the budget is disarmed by the first metric report),
+- a DEVICE fault under a sharded cohort rebuilds the mesh from survivors
+  and resumes members from their checkpoints — zero lost trials,
+- the orchestrator preflight gate fails a wedged pool fast.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import jax
+import pytest
+
+from katib_tpu.core.types import (
+    ObjectiveSpec,
+    ObjectiveType,
+    ParameterAssignment,
+    Trial,
+    TrialCondition,
+    TrialSpec,
+)
+from katib_tpu.parallel.mesh import (
+    TRIAL_AXIS,
+    make_mesh,
+    narrowed_trial_mesh,
+    trial_axis_size,
+)
+from katib_tpu.runner.cohort import attach_cohort_fn, run_cohort
+from katib_tpu.runner.trial_runner import run_trial
+from katib_tpu.store.base import MemoryObservationStore
+from katib_tpu.utils import meshhealth
+from katib_tpu.utils import observability as obs
+from katib_tpu.utils.faults import (
+    FailureKind,
+    FaultInjector,
+    InjectedFault,
+    classify_exception,
+    classify_traceback,
+)
+from katib_tpu.utils.watchdog import Watchdog
+
+OBJECTIVE = ObjectiveSpec(type=ObjectiveType.MINIMIZE, objective_metric_name="loss")
+
+
+class TestFailureKinds:
+    def test_device_and_compile_hang_are_retryable(self):
+        assert FailureKind.DEVICE.retryable
+        assert FailureKind.COMPILE_HANG.retryable
+        assert not FailureKind.PERMANENT.retryable
+
+    def test_injected_fault_kind_passthrough(self):
+        e = InjectedFault("injected device fault", FailureKind.DEVICE)
+        assert classify_exception(e) is FailureKind.DEVICE
+
+    def test_device_markers_classify_from_text(self):
+        assert (
+            classify_traceback("RuntimeError: device is in an invalid state")
+            is FailureKind.DEVICE
+        )
+        assert (
+            classify_exception(RuntimeError("chip has been disabled on host"))
+            is FailureKind.DEVICE
+        )
+
+    def test_device_marker_wins_over_transient(self):
+        # a preemption message that also names a dead chip is a device
+        # fault first: retry must go through the mesh-health path
+        text = "worker preempted: device not found (slice health check)"
+        assert classify_traceback(text) is FailureKind.DEVICE
+
+
+class TestProbe:
+    def test_all_devices_healthy(self):
+        devs = jax.devices()
+        report = meshhealth.probe_devices(devs, deadline=30.0)
+        assert report.ok()
+        assert report.status == meshhealth.HEALTHY
+        assert report.healthy_count == len(devs)
+        assert report.wedged_count == 0
+        for d in report.devices:
+            assert d.status == meshhealth.HEALTHY
+            assert d.error == ""
+
+    def test_injected_wedge_is_immediate(self):
+        devs = jax.devices()
+        injector = FaultInjector().wedge_device(devs[1].id)
+        t0 = time.monotonic()
+        report = meshhealth.probe_devices(devs, deadline=30.0, injector=injector)
+        assert time.monotonic() - t0 < 15.0  # injected wedge burns no deadline
+        assert not report.ok()
+        assert report.status == meshhealth.WEDGED
+        assert report.wedged_count == 1
+        wedged = [d for d in report.devices if d.status == meshhealth.WEDGED]
+        assert wedged[0].error == "injected device wedge"
+        assert any(e.get("seam") == "device-probe" for e in injector.log)
+        assert "wedged" in report.summary()
+
+    def test_slow_probe_hits_deadline_bounded(self):
+        devs = jax.devices()[:2]
+
+        def stuck_prober(device):
+            time.sleep(10.0)
+
+        t0 = time.monotonic()
+        report = meshhealth.probe_devices(devs, deadline=0.3, prober=stuck_prober)
+        assert time.monotonic() - t0 < 5.0  # bounded, not 10s per device
+        assert report.status == meshhealth.WEDGED
+        assert report.wedged_count == 2
+        for d in report.devices:
+            assert "did not complete" in d.error
+
+    def test_expected_but_missing_devices_are_absent(self):
+        devs = jax.devices()[:2]
+        present = {d.id for d in devs}
+        report = meshhealth.probe_devices(
+            devs, deadline=30.0, expect_ids=sorted(present) + [99]
+        )
+        assert not report.ok()
+        assert report.status == meshhealth.ABSENT
+        absent = [d for d in report.devices if d.status == meshhealth.ABSENT]
+        assert len(absent) == 1 and absent[0].device == "?:99"
+
+    def test_empty_pool_is_not_ok(self):
+        report = meshhealth.probe_devices([], deadline=1.0)
+        assert not report.ok()
+        assert report.status == meshhealth.ABSENT
+
+    def test_healthy_devices_filter(self):
+        devs = jax.devices()
+        injector = FaultInjector().wedge_device(devs[0].id)
+        report = meshhealth.probe_devices(devs, deadline=30.0, injector=injector)
+        alive = meshhealth.healthy_devices(devs, report)
+        assert devs[0] not in alive
+        assert len(alive) == len(devs) - 1
+
+
+class TestNarrowedMesh:
+    def test_none_mesh(self):
+        assert narrowed_trial_mesh(None, jax.devices()) is None
+
+    def test_mesh_without_trial_axis(self):
+        import numpy as np
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+        assert narrowed_trial_mesh(mesh, jax.devices()[:1]) is None
+
+    def test_narrows_four_to_three(self):
+        devs = jax.devices()
+        mesh = make_mesh({TRIAL_AXIS: 4}, devices=devs[:4])
+        survivors = [devs[0], devs[2], devs[3]]
+        narrowed = narrowed_trial_mesh(mesh, survivors)
+        assert narrowed is not None
+        assert trial_axis_size(narrowed) == 3
+        assert [d.id for d in narrowed.devices.flat] == [d.id for d in survivors]
+
+    def test_no_survivors_degrades_to_none(self):
+        devs = jax.devices()
+        mesh = make_mesh({TRIAL_AXIS: 2}, devices=devs[:2])
+        assert narrowed_trial_mesh(mesh, []) is None
+
+    def test_never_widens(self):
+        devs = jax.devices()
+        mesh = make_mesh({TRIAL_AXIS: 4}, devices=devs[:4])
+        assert narrowed_trial_mesh(mesh, devs) is None  # 8 survivors > 4
+
+
+def _whitebox_trial(name, compile_deadline=None):
+    def train_fn(ctx):
+        if not ctx.report(step=0, loss=1.0):
+            return
+        ctx.report(step=1, loss=0.5)
+
+    return Trial(
+        name=name,
+        experiment_name="meshhealth-test",
+        spec=TrialSpec(
+            assignments=[ParameterAssignment("x", 1.0)],
+            train_fn=train_fn,
+            compile_deadline_seconds=compile_deadline,
+        ),
+    )
+
+
+class TestCompileWatchdog:
+    def test_compile_hang_settles_as_retryable_compile_hang(self):
+        trial = _whitebox_trial("compile-wedge", compile_deadline=0.25)
+        injector = FaultInjector().compile_hang(trial.name, attempt=1)
+        store = MemoryObservationStore()
+        wd = Watchdog(interval=0.05)
+        hangs_before = obs.compile_hangs.get()
+        try:
+            result = run_trial(
+                trial, store, OBJECTIVE, None, threading.Event(), injector,
+                watchdog=wd,
+            )
+        finally:
+            wd.stop()
+        assert result.condition is TrialCondition.FAILED
+        assert result.failure_kind is FailureKind.COMPILE_HANG
+        assert result.failure_kind.retryable
+        assert "compile watchdog" in result.message
+        assert obs.compile_hangs.get() == hangs_before + 1
+        assert any(e.get("seam") == "compile-hang" for e in injector.log)
+
+    def test_first_report_disarms_the_compile_budget(self):
+        # the trial outlives its compile budget in wall-clock but reports
+        # BEFORE the budget expires: the one-shot heartbeat must be closed,
+        # not fired mid-training
+        def slow_after_first_report(ctx):
+            ctx.report(step=0, loss=1.0)  # disarms the compile watchdog
+            time.sleep(0.45)
+            ctx.report(step=1, loss=0.5)
+
+        trial = Trial(
+            name="compile-ok",
+            experiment_name="meshhealth-test",
+            spec=TrialSpec(
+                assignments=[],
+                train_fn=slow_after_first_report,
+                compile_deadline_seconds=0.2,
+            ),
+        )
+        store = MemoryObservationStore()
+        wd = Watchdog(interval=0.05)
+        hangs_before = obs.compile_hangs.get()
+        try:
+            result = run_trial(
+                trial, store, OBJECTIVE, None, threading.Event(), watchdog=wd,
+            )
+        finally:
+            wd.stop()
+        assert result.condition is TrialCondition.SUCCEEDED, result.message
+        assert obs.compile_hangs.get() == hangs_before
+
+    def test_orchestrator_retries_compile_hang_to_success(self, tmp_path):
+        from katib_tpu.orchestrator.orchestrator import Orchestrator
+        from tests.helpers import make_spec
+
+        spec = make_spec(
+            train_fn=lambda ctx: ctx.report(loss=1.0),
+            max_trial_count=1,
+            parallel_trial_count=1,
+            max_retries=2,
+            retry_backoff_seconds=0.01,
+            compile_deadline_seconds=0.3,
+        )
+        injector = FaultInjector().compile_hang(0, attempt=1)
+        exp = Orchestrator(
+            workdir=str(tmp_path), fault_injector=injector
+        ).run(spec)
+        (trial,) = exp.trials.values()
+        assert trial.condition is TrialCondition.SUCCEEDED, trial.message
+        assert trial.retry_count == 1
+        assert trial.failure_kind == FailureKind.COMPILE_HANG
+
+
+def _cohort_member(name, x, train_fn, ckpt_dir=None):
+    t = Trial(
+        name=name,
+        experiment_name="meshhealth-test",
+        spec=TrialSpec(
+            assignments=[ParameterAssignment("x", x)],
+            train_fn=train_fn,
+        ),
+    )
+    t.checkpoint_dir = ckpt_dir
+    return t
+
+
+def _progress(ckpt_dir):
+    path = os.path.join(ckpt_dir, "progress.txt")
+    if os.path.exists(path):
+        with open(path) as f:
+            return int(f.read())
+    return 0
+
+
+def _checkpoint_cohort_fn(steps=3, on_step=None, calls=None, starts_log=None):
+    """Checkpoint-aware cohort twin: resumes every member from its
+    progress file, reports one loss row per member per step."""
+
+    def cohort_fn(cctx):
+        if calls is not None:
+            calls.append(cctx.trial_devices)
+        starts = [_progress(d) for d in cctx.checkpoint_dirs]
+        if starts_log is not None:
+            starts_log.append(min(starts))
+        for step in range(min(starts), steps):
+            xs = [p.get("x", 0.0) for p in cctx.params_list]
+            alive = cctx.report(step=step, loss=[abs(x) + 1.0 / (step + 1) for x in xs])
+            for d in cctx.checkpoint_dirs:
+                with open(os.path.join(d, "progress.txt"), "w") as f:
+                    f.write(str(step + 1))
+            if on_step is not None:
+                on_step(step)
+            if not alive:
+                break
+
+    return cohort_fn
+
+
+class TestElasticDegradation:
+    def _members(self, tmp_path, train_fn, k=4):
+        members = []
+        for i in range(k):
+            d = str(tmp_path / f"m{i}")
+            os.makedirs(d, exist_ok=True)
+            members.append(_cohort_member(f"m{i}", 0.1 * (i + 1), train_fn, d))
+        return members
+
+    def test_upfront_wedge_degrades_and_completes_all(self, tmp_path):
+        devs = jax.devices()
+        mesh = make_mesh({TRIAL_AXIS: 4}, devices=devs[:4])
+        injector = FaultInjector().wedge_device(devs[1].id)
+        calls = []
+        train_fn = lambda ctx: ctx.report(loss=1.0)  # noqa: E731
+        attach_cohort_fn(train_fn, _checkpoint_cohort_fn(calls=calls))
+        members = self._members(tmp_path, train_fn)
+        store = MemoryObservationStore()
+        degraded_before = obs.mesh_degraded.get()
+
+        results = run_cohort(members, store, OBJECTIVE, mesh=mesh, injector=injector)
+
+        # one degradation: the wedged device is probed out, the cohort
+        # re-runs on a 3-wide trial axis, nothing falls back to serial
+        assert obs.mesh_degraded.get() == degraded_before + 1
+        assert any(e.get("seam") == "cohort-device" for e in injector.log)
+        assert calls == [3]
+        for t in members:
+            assert results[t.name].condition is TrialCondition.SUCCEEDED, (
+                t.name,
+                results[t.name].message,
+            )
+            assert store.observation_for(t.name, OBJECTIVE) is not None
+        key = f"{devs[1].platform}:{devs[1].id}"
+        assert obs.device_healthy.get(device=key, platform=devs[1].platform) == 0.0
+
+    def test_midflight_fault_resumes_members_from_checkpoint(self, tmp_path):
+        devs = jax.devices()
+        mesh = make_mesh({TRIAL_AXIS: 4}, devices=devs[:4])
+        injector = FaultInjector()
+        calls, starts_log = [], []
+
+        def die_once(step):
+            # tier 0, after step 0's checkpoints landed: the chip dies
+            if step == 0 and len(calls) == 1:
+                injector.wedge_device(devs[1].id)
+                raise InjectedFault(
+                    "injected device fault: chip has been disabled",
+                    FailureKind.DEVICE,
+                )
+
+        train_fn = lambda ctx: ctx.report(loss=1.0)  # noqa: E731
+        attach_cohort_fn(
+            train_fn,
+            _checkpoint_cohort_fn(on_step=die_once, calls=calls, starts_log=starts_log),
+        )
+        members = self._members(tmp_path, train_fn)
+        store = MemoryObservationStore()
+        degraded_before = obs.mesh_degraded.get()
+
+        results = run_cohort(members, store, OBJECTIVE, mesh=mesh, injector=injector)
+
+        assert obs.mesh_degraded.get() == degraded_before + 1
+        assert calls == [4, 3]  # trial-axis width per tier
+        assert starts_log == [0, 1]  # tier 1 resumed past the checkpointed step
+        for t in members:
+            assert results[t.name].condition is TrialCondition.SUCCEEDED, (
+                t.name,
+                results[t.name].message,
+            )
+            # metrics intact across the degradation: step-0 rows from tier 0
+            # plus the resumed rows from tier 1
+            assert store.observation_for(t.name, OBJECTIVE) is not None
+        for m in members:
+            assert _progress(m.checkpoint_dir) == 3
+
+    def test_non_device_failure_falls_back_to_serial(self, tmp_path):
+        def train_fn(ctx):
+            ctx.report(loss=float(ctx.params.get("x", 0.0)))
+
+        def broken_cohort(cctx):
+            raise RuntimeError("cohort exploded")
+
+        attach_cohort_fn(train_fn, broken_cohort)
+        members = self._members(tmp_path, train_fn)
+        store = MemoryObservationStore()
+        fallbacks_before = obs.cohort_fallbacks.get()
+        degraded_before = obs.mesh_degraded.get()
+
+        results = run_cohort(members, store, OBJECTIVE)
+
+        assert obs.cohort_fallbacks.get() == fallbacks_before + 1
+        assert obs.mesh_degraded.get() == degraded_before  # not a device fault
+        for t in members:
+            assert results[t.name].condition is TrialCondition.SUCCEEDED
+
+
+class TestPreflightGate:
+    def test_wedged_pool_fails_the_experiment_fast(self, tmp_path):
+        from katib_tpu.orchestrator.orchestrator import Orchestrator
+        from tests.helpers import make_spec
+
+        injector = FaultInjector()
+        for d in jax.devices():
+            injector.wedge_device(d.id)
+        spec = make_spec(
+            train_fn=lambda ctx: ctx.report(loss=1.0),
+            max_trial_count=1,
+            parallel_trial_count=1,
+        )
+        orch = Orchestrator(
+            workdir=str(tmp_path), fault_injector=injector, preflight=True
+        )
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="preflight"):
+            orch.run(spec)
+        assert time.monotonic() - t0 < 30.0
+        report = meshhealth.last_report()
+        assert report is not None and report.status == meshhealth.WEDGED
+
+    def test_healthy_pool_passes_the_gate(self, tmp_path):
+        from katib_tpu.orchestrator.orchestrator import Orchestrator
+        from tests.helpers import make_spec
+
+        spec = make_spec(
+            train_fn=lambda ctx: ctx.report(loss=1.0),
+            max_trial_count=1,
+            parallel_trial_count=1,
+        )
+        exp = Orchestrator(workdir=str(tmp_path), preflight=True).run(spec)
+        assert exp.succeeded_count == 1
+        report = meshhealth.last_report()
+        assert report is not None and report.ok()
+        # the preflight verdict rides into status.json for the UI
+        from katib_tpu.orchestrator.status import read_status
+
+        status = read_status(str(tmp_path), exp.name)
+        assert status is not None
+        assert status["device_health"]["status"] == meshhealth.HEALTHY
