@@ -580,6 +580,39 @@ def _child() -> None:
 
     img_per_sec = BATCH * TIMED_STEPS / dt
     step_secs = dt / TIMED_STEPS
+
+    # fused-loop point: the SAME step folded lax.scan-style into one
+    # dispatch per window (the search's default execution path since the
+    # device-resident step loop flip) — the per-dispatch Python/transfer
+    # overhead the eager numbers above pay per STEP is paid once per
+    # WINDOW here, so (fused - eager) is the measured dispatch tax the
+    # ROADMAP item-1 10x target collects on.  BENCH_STEP_LOOP_WINDOW
+    # overrides the fold (default: TIMED_STEPS, one dispatch per timing).
+    loop_window = max(
+        1, int(os.environ.get("BENCH_STEP_LOOP_WINDOW", str(TIMED_STEPS)))
+    )
+
+    def _fused_loop(s, b):
+        def body(c, _):
+            c, m = step(c, b, b)
+            return c, m["train_loss"]
+
+        return jax.lax.scan(body, s, None, length=loop_window)
+
+    loop_runner = jax.jit(_fused_loop, donate_argnums=(0,))
+    t_lc0 = time.perf_counter()
+    state, losses = loop_runner(state, batch)
+    float(jnp.sum(losses))  # warm: trace+compile+first execution
+    loop_compile_secs = time.perf_counter() - t_lc0
+    loop_dispatches = max(1, TIMED_STEPS // loop_window)
+    t_l0 = time.perf_counter()
+    for _ in range(loop_dispatches):
+        state, losses = loop_runner(state, batch)
+    float(jnp.sum(losses))  # host fetch, same integrity rule as above
+    loop_dt = time.perf_counter() - t_l0
+    loop_steps = loop_window * loop_dispatches
+    loop_img_per_sec = BATCH * loop_steps / loop_dt
+    loop_step_secs = loop_dt / loop_steps
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
     # MFU denominator must match the COMPUTE dtype (the supernet casts to
     # its flax compute dtype internally — f32 inputs still run bf16 matmuls)
@@ -611,6 +644,23 @@ def _child() -> None:
                 "dtype": dtype_key,
                 "platform": platform,
                 "step_secs": round(step_secs, 4),
+                # the eager numbers above dispatch one step per host call
+                "steps_per_dispatch": 1,
+                "fused_loop": {
+                    "metric": "darts_fused_loop_throughput",
+                    "value": round(float(loop_img_per_sec), 2),
+                    "unit": "images/sec",
+                    "step_secs": round(loop_step_secs, 4),
+                    "steps_per_dispatch": loop_window,
+                    "dispatches": loop_dispatches,
+                    "compile_secs": round(loop_compile_secs, 1),
+                    "mfu": round(
+                        (flops_per_step / loop_step_secs) / peak
+                        if flops_per_step
+                        else 0.0,
+                        6,
+                    ),
+                },
                 "flops_per_step": flops_per_step,
                 "init_secs": round(init_secs, 1),
                 "compile_secs": round(compile_secs, 1),

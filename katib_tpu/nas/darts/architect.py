@@ -70,10 +70,15 @@ def make_search_step(
     loss_fn: Callable[[Any, Alphas, Any], jnp.ndarray],
     hyper: DartsHyper,
     mesh=None,
+    jit: bool = True,
 ) -> Callable:
     """Build ``search_step(state, train_batch, val_batch) -> (state, metrics)``.
 
     ``loss_fn(weights, alphas, batch) -> scalar`` is the supernet loss.
+    ``jit=False`` returns the raw (untraced) step for callers that inline it
+    into a larger jitted program — the windowed ``lax.scan`` step loop in
+    ``search.py`` wraps N steps in ONE jit and must not nest a sharded jit
+    inside its scan body.
     """
     a_tx = optax.chain(
         optax.add_decayed_weights(hyper.alpha_weight_decay),
@@ -171,6 +176,9 @@ def make_search_step(
         if hyper.debug_alpha_grad:
             metrics["alpha_grad"] = a_grad
         return new_state, metrics
+
+    if not jit:
+        return search_step
 
     if mesh is None:
         return jax.jit(search_step, donate_argnums=(0,))

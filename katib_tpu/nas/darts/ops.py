@@ -14,7 +14,10 @@ TPU-first choices:
   and keeps the whole supernet a pure function — no mutable collections to
   thread through the bilevel derivatives;
 - the mixed op computes every primitive and contracts with softmax weights in
-  one einsum — a static-shape program XLA can schedule densely on the MXU.
+  one pass — on TPU through the fused Pallas kernel in
+  ``katib_tpu/ops/mixed_op.py`` (one read of the stacked activations), on
+  other backends through the reference einsum (``KATIB_PALLAS_MIXED_OP``
+  selects; the kernel module doc has the mode table).
 """
 
 from __future__ import annotations
@@ -25,6 +28,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from katib_tpu.ops.depthwise import DepthwiseConv, PointwiseConv
+from katib_tpu.ops.mixed_op import mixed_op_sum
 
 DEFAULT_PRIMITIVES = (
     "none",
@@ -242,4 +246,6 @@ class MixedOp(nn.Module):
             for p in self.primitives
         ]
         stacked = jnp.stack(outs, axis=0)  # (n_ops, N, H, W, C)
-        return jnp.einsum("o,onhwc->nhwc", weights.astype(stacked.dtype), stacked)
+        # fused weighting+accumulation (ops/mixed_op.py): Pallas on TPU,
+        # the reference einsum elsewhere — KATIB_PALLAS_MIXED_OP overrides
+        return mixed_op_sum(weights, stacked)

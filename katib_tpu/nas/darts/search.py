@@ -41,6 +41,15 @@ from katib_tpu.utils.booleans import parse_bool
 
 _SEARCH_META = "search_meta.json"
 
+
+class StepLoopUnavailable(RuntimeError):
+    """An explicitly-requested device-resident step loop cannot engage.
+
+    Raised instead of silently running the slow host-driven path: a silent
+    fallback once burned a TPU window on the wrong program shape.  The
+    message enumerates exactly why the loop is inert so the trial settles
+    with an actionable reason."""
+
 # resolved ONCE at import: run() used to re-read the env on every call, so
 # two searches in one process could silently run with different unrolls if
 # the harness mutated the env between them; the A/B harness sets the env
@@ -122,6 +131,8 @@ def run_darts_search(
     remat: bool = True,
     remat_policy: str | None = None,
     device_data: bool | None = None,
+    step_loop: bool | None = None,
+    step_loop_window: int | None = None,
     fused: bool = False,
     scan_unroll: int | None = None,
     augment_fn=None,
@@ -148,6 +159,17 @@ def run_darts_search(
     via ``KATIB_DEVICE_DATA``.  Batch composition per epoch is IDENTICAL to
     the host-streamed path (same ``default_rng([seed, epoch])`` permutation
     draw order), so resume and reproducibility semantics do not change.
+
+    ``step_loop`` / ``step_loop_window``: the DEFAULT execution path folds
+    ``step_loop_window`` bilevel steps into one ``lax.scan``-driven device
+    dispatch over the device-resident splits (window default: the whole
+    epoch, i.e. one dispatch per epoch).  ``KATIB_STEP_LOOP=0`` (or
+    ``step_loop=False``) restores eager stepping — one dispatch per step,
+    the program to reach for when the epoch-scale compile is the
+    bottleneck.  An EXPLICIT ``step_loop=True`` / ``KATIB_STEP_LOOP=1``
+    that cannot engage raises :class:`StepLoopUnavailable` instead of
+    silently running the slow path.  Batch composition, augmentation
+    keying, and resume semantics are identical across all paths.
     """
     net = DartsNetwork(
         primitives=tuple(primitives),
@@ -242,14 +264,25 @@ def run_darts_search(
     prefetch_requested = native_prefetch is True or parse_bool(
         os.environ.get("KATIB_NATIVE_LOADER")
     )
+    # the windowed device-resident step loop is the DEFAULT path; an
+    # explicit request (param or env) that cannot engage must raise
+    # (StepLoopUnavailable) rather than warn-and-run-slow
+    env_sl = os.environ.get("KATIB_STEP_LOOP")
+    step_loop_explicit = step_loop is True or (
+        env_sl is not None and parse_bool(env_sl)
+    )
+    if step_loop is None:
+        step_loop = parse_bool(env_sl, default=True)
     if device_data is None:
         env = os.environ.get("KATIB_DEVICE_DATA")
+        # mesh runs keep device-resident splits only under the step loop
+        # (replicated placement + in-scan sharding constraints); the eager
+        # mesh path keeps its explicit per-batch shard_batch placement
         device_data = (
-            mesh is None and not prefetch_requested
+            not prefetch_requested and (mesh is None or step_loop)
             if env is None
             else parse_bool(env)
         )
-    # scan_steps is the true per-epoch step count (steps_per_epoch above is
     # Search-phase train-time augmentation (reference trains the search on
     # transformed CIFAR — crop+flip, run_trial.py:98-111 via
     # utils.get_dataset; cutout is augment-phase only).  Opt in with the
@@ -270,92 +303,145 @@ def run_darts_search(
         jax.jit(lambda k, xb: augment_fn(k, xb)) if augment_fn is not None else None
     )
 
-    # clamped to >=1 for the lr schedule even when the split is smaller than
-    # one batch — the streamed path then just yields zero batches)
+    # scan_steps is the true per-epoch step count (steps_per_epoch above is
+    # clamped to >=1 for the lr schedule even when the split is smaller
+    # than one batch — the streamed path then just yields zero batches)
     scan_steps = len(x_w) // batch_size
-    device_data = device_data and mesh is None and scan_steps >= 1
-    scan_epoch = None
-    # KATIB_STEP_LOOP=1: keep the splits device-resident but drive the
-    # SINGLE-STEP program from the host (one async dispatch per step plus a
-    # tiny on-device gather) instead of jitting the whole-epoch scan.  The
-    # epoch scan is the throughput default, but its program is ~epoch-sized
-    # and a terminal-side compile of it can dwarf the single step's (~8 min
-    # measured); when the pool's compile path is the bottleneck this mode
-    # trades ~1.5 ms/step dispatch overhead for compiling only the step.
-    # Dispatches stay async (losses fetched once per epoch), batch
-    # composition and augmentation keying are identical to the scan path.
-    step_loop = parse_bool(os.environ.get("KATIB_STEP_LOOP"))
-    if step_loop and not device_data:
-        # step-loop mode only exists inside the device-data path; a silent
-        # fallback here once burned a TPU window on the wrong program shape
-        # (the epoch-scan compile it was set to avoid), so say why it is
-        # inert instead of quietly ignoring the flag
-        import warnings
 
+    # step-loop engagement gate.  An explicit request that cannot engage
+    # RAISES — a silent fallback once burned a TPU window on the wrong
+    # program shape (the epoch-scale compile it was set to avoid); a
+    # default-on loop that cannot engage quietly runs the eager path.
+    if step_loop and (not device_data or scan_steps < 1):
         reasons = []
-        if mesh is not None:
-            reasons.append("a device mesh is set")
         if prefetch_requested:
-            reasons.append("native prefetch was requested")
+            reasons.append(
+                "native prefetch was requested (it disables the "
+                "device-resident data default)"
+            )
         env_dd = os.environ.get("KATIB_DEVICE_DATA")
         if env_dd is not None and not parse_bool(env_dd):
             reasons.append("KATIB_DEVICE_DATA=0 disables the device-data path")
+        elif not device_data and not reasons:
+            reasons.append("device_data=False was passed")
         if scan_steps < 1:
             reasons.append("the train split is smaller than one batch")
-        warnings.warn(
-            "KATIB_STEP_LOOP=1 is set but the device-data path is inactive ("
-            + ("; ".join(reasons) or "device_data resolved to False")
-            + ") — falling back to the streamed per-batch loop, NOT the "
-            "single-step device-resident loop",
-            RuntimeWarning,
-            stacklevel=2,
+        if step_loop_explicit:
+            raise StepLoopUnavailable(
+                "the device-resident step loop was explicitly requested "
+                "(step_loop/KATIB_STEP_LOOP) but cannot engage: "
+                + ("; ".join(reasons) or "device_data resolved to False")
+            )
+        step_loop = False
+
+    # scan window: param > KATIB_STEP_LOOP_WINDOW > whole epoch (one
+    # dispatch per epoch, the maximum fold and the throughput default)
+    if step_loop_window is None:
+        env_w = os.environ.get("KATIB_STEP_LOOP_WINDOW", "").strip()
+        step_loop_window = int(env_w) if env_w else None
+    if step_loop_window is not None and step_loop_window < 1:
+        raise ValueError(
+            f"step_loop_window must be a positive step count, got {step_loop_window}"
         )
+    window = (
+        scan_steps
+        if step_loop_window is None
+        else max(1, min(step_loop_window, scan_steps))
+    )
+
+    # unroll>1 inlines that many bilevel steps per XLA While-loop
+    # iteration — the microbench found a fixed ~1.35-1.5 ms
+    # per-scan-iteration floor (artifacts/flagship/op_microbench.json),
+    # and unrolling amortizes it at the cost of a proportionally
+    # bigger program (longer compile, more code HBM).  Default 1;
+    # KATIB_SCAN_UNROLL overrides for the A/B harness (resolved once
+    # at module import, not per run).
+    if scan_unroll is None:
+        scan_unroll = _DEFAULT_SCAN_UNROLL
+
     gather_batches = None
-    if device_data:
-        # splits live in HBM for the whole search; the epoch is one jitted
-        # scan over [steps, batch] permutation indices with on-device gather
+    window_fn = None
+    if step_loop:
+        # THE default path: splits live in HBM (replicated over the mesh
+        # when one is set) for the whole search, and every dispatch is one
+        # jitted lax.scan over [window, batch] permutation indices with
+        # on-device gather — per dispatch the host sends two small index
+        # arrays instead of `window` image batches
+        raw_step = make_search_step(loss_fn, hyper, mesh, jit=False)
+        if mesh is None:
+            constrain = None
+            xw_d, yw_d, xa_d, ya_d = (
+                jax.device_put(a) for a in (x_w, y_w, x_a, y_a)
+            )
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from katib_tpu.parallel.mesh import DATA_AXIS, replicated
+
+            rep = replicated(mesh)
+            batch_sharding = NamedSharding(mesh, PartitionSpec(DATA_AXIS))
+
+            def constrain(t):
+                # pin gathered batches to the data axis so the partitioner
+                # runs the in-scan step exactly like the eager path's
+                # explicit shard_batch placement
+                return jax.lax.with_sharding_constraint(t, batch_sharding)
+
+            xw_d, yw_d, xa_d, ya_d = (
+                jax.device_put(a, rep) for a in (x_w, y_w, x_a, y_a)
+            )
+
+        def _window(state, xw, yw, xa, ya, w_ix, a_ix):
+            def body(s, ix):
+                wi, ai = ix
+                xb, yb = xw[wi], yw[wi]
+                vx, vy = xa[ai], ya[ai]
+                if constrain is not None:
+                    xb, yb, vx, vy = (constrain(t) for t in (xb, yb, vx, vy))
+                if augment_fn is not None:
+                    xb = augment_fn(jax.random.fold_in(aug_key, s.step), xb)
+                s, m = raw_step(s, (xb, yb), (vx, vy))
+                return s, m["train_loss"]
+
+            return jax.lax.scan(
+                body, state, (w_ix, a_ix), unroll=max(1, scan_unroll)
+            )
+
+        # donate the carried state: the bilevel step holds two full
+        # weight copies already — double-buffering a third across the
+        # window call would waste HBM
+        if mesh is None:
+            window_fn = jax.jit(_window, donate_argnums=(0,))
+        else:
+            window_fn = jax.jit(
+                _window,
+                in_shardings=(rep,) * 7,
+                out_shardings=(rep, rep),
+                donate_argnums=(0,),
+            )
+    elif device_data and mesh is None and scan_steps >= 1:
+        # eager stepping over device-resident splits (KATIB_STEP_LOOP=0):
+        # one async dispatch per step plus a tiny on-device gather, the
+        # separately jitted search_step as the only compiled program — the
+        # mode to reach for when the pool's compile path is the bottleneck
+        # (a terminal-side epoch-program compile was measured at ~8 min
+        # against the single step's seconds).  Dispatches stay async
+        # (losses fetched once per epoch); batch composition and
+        # augmentation keying are identical to the windowed path.
         xw_d, yw_d, xa_d, ya_d = (
             jax.device_put(a) for a in (x_w, y_w, x_a, y_a)
         )
-
-        # unroll>1 inlines that many bilevel steps per XLA While-loop
-        # iteration — the microbench found a fixed ~1.35-1.5 ms
-        # per-scan-iteration floor (artifacts/flagship/op_microbench.json),
-        # and unrolling amortizes it at the cost of a proportionally
-        # bigger program (longer compile, more code HBM).  Default 1;
-        # KATIB_SCAN_UNROLL overrides for the A/B harness (resolved once
-        # at module import, not per run).
-        if scan_unroll is None:
-            scan_unroll = _DEFAULT_SCAN_UNROLL
-
-        if step_loop:
-            # per-step on-device gather; the step itself is the separately
-            # jitted search_step program
-            gather_batches = jax.jit(
-                lambda xw, yw, xa, ya, wi, ai: (
-                    (xw[wi], yw[wi]),
-                    (xa[ai], ya[ai]),
-                )
+        gather_batches = jax.jit(
+            lambda xw, yw, xa, ya, wi, ai: (
+                (xw[wi], yw[wi]),
+                (xa[ai], ya[ai]),
             )
-        else:
-
-            def _epoch(state, xw, yw, xa, ya, w_ix, a_ix):
-                def body(s, ix):
-                    wi, ai = ix
-                    xb = xw[wi]
-                    if augment_fn is not None:
-                        xb = augment_fn(jax.random.fold_in(aug_key, s.step), xb)
-                    s, m = search_step(s, (xb, yw[wi]), (xa[ai], ya[ai]))
-                    return s, m["train_loss"]
-
-                return jax.lax.scan(
-                    body, state, (w_ix, a_ix), unroll=max(1, scan_unroll)
-                )
-
-            # donate the carried state: the bilevel step holds two full
-            # weight copies already — double-buffering a third across the
-            # epoch call would waste HBM
-            scan_epoch = jax.jit(_epoch, donate_argnums=(0,))
+        )
+    # window-size gauge: 0 when the step loop is not engaged, so a low-MFU
+    # run is diagnosable from /api/status alone
+    obs.step_loop_window.set(
+        float(window) if window_fn is not None else 0.0, workload="darts"
+    )
 
     # optional native prefetch: C++ worker threads gather the next shuffled
     # batch while the device runs the current bilevel step (enable with
@@ -438,32 +524,46 @@ def run_darts_search(
         for epoch in range(start_epoch, num_epochs):
             t_mark = time.perf_counter()
             t_epoch = t_mark
-            if scan_epoch is not None:
+            if window_fn is not None:
                 n_used = scan_steps * batch_size
                 w_ix, a_ix = _draw_epoch_indices(
                     seed, epoch, len(x_w), len(x_a), n_used
                 )
-                shape = (scan_steps, batch_size)
+                w_ix = w_ix.reshape(scan_steps, batch_size)
+                a_ix = a_ix.reshape(scan_steps, batch_size)
                 t_dispatch = time.perf_counter()
-                state, losses = scan_epoch(
-                    state,
-                    xw_d,
-                    yw_d,
-                    xa_d,
-                    ya_d,
-                    jnp.asarray(w_ix.reshape(shape), jnp.int32),
-                    jnp.asarray(a_ix.reshape(shape), jnp.int32),
-                )
+                loss_parts = []
+                dispatches = 0
+                pos = 0
+                while pos < scan_steps:
+                    k = min(window, scan_steps - pos)
+                    # full windows all reuse one executable; the remainder
+                    # chunk (at most one per epoch) gets its own trace
+                    state, losses = window_fn(
+                        state,
+                        xw_d,
+                        yw_d,
+                        xa_d,
+                        ya_d,
+                        jnp.asarray(w_ix[pos : pos + k], jnp.int32),
+                        jnp.asarray(a_ix[pos : pos + k], jnp.int32),
+                    )
+                    loss_parts.append(losses)
+                    dispatches += 1
+                    pos += k
                 dispatch_s = time.perf_counter() - t_dispatch
                 steps = scan_steps
                 t_mark = _trace("scan-dispatch", t_mark)
                 t_fetch = time.perf_counter()
-                train_loss = float(jnp.sum(losses))
+                # dispatches stay async; ONE device->host transfer per epoch
+                train_loss = float(
+                    np.sum(np.concatenate(jax.device_get(loss_parts)))
+                )
                 fetch_s = time.perf_counter() - t_fetch
                 t_mark = _trace("loss-fetch", t_mark)
                 if epoch == start_epoch:
-                    # whole-epoch scan: dispatch blocks on trace+compile,
-                    # the loss fetch blocks on the epoch's execution
+                    # windowed scan: the first dispatch blocks on
+                    # trace+compile, the loss fetch blocks on execution
                     _record_first_step(dispatch_s, fetch_s, "darts-scan")
             else:
                 # one shared per-step loop body for every host-driven epoch
@@ -539,6 +639,7 @@ def run_darts_search(
                         state, metrics = search_step(state, wb, ab)
                     step_losses.append(metrics["train_loss"])
                 steps = len(step_losses)
+                dispatches = steps  # eager: one dispatch per step
                 t_mark = _trace("step-dispatch", t_mark)
                 train_loss = (
                     float(np.sum(jax.device_get(step_losses))) if steps else 0.0
@@ -556,6 +657,11 @@ def run_darts_search(
             images_per_s = (steps * batch_size) / epoch_s if epoch_s > 0 else 0.0
             obs.trial_images_per_second.set(images_per_s, workload="darts")
             obs.record_device_memory()
+            # steps-per-dispatch is THE dispatch-overhead diagnostic: 1.0
+            # means every step pays a host round-trip (eager), `window`
+            # means the scan loop is folding that many steps per dispatch
+            spd = steps / dispatches if dispatches else 0.0
+            obs.steps_per_dispatch.set(spd, workload="darts")
             tracing.record_span(
                 "darts.epoch",
                 epoch_s,
@@ -563,6 +669,10 @@ def run_darts_search(
                 steps=steps,
                 images_per_s=round(images_per_s, 1),
                 val_accuracy=round(val_acc, 4),
+                step_loop=window_fn is not None,
+                step_loop_window=window if window_fn is not None else 0,
+                device_data=bool(window_fn is not None or gather_batches is not None),
+                steps_per_dispatch=round(spd, 2),
             )
             history.append(
                 {
@@ -668,6 +778,10 @@ def darts_trial(ctx) -> None:
     batch_size = int(settings.get("batch_size", 128))
     stem_multiplier = int(settings.get("stem_multiplier", 3))
     num_epochs = int(settings.get("num_epochs", 10))
+    # step-loop knobs: the Katib-style camelCase spelling (stepLoopWindow,
+    # the ISSUE/CR surface) and the snake_case used by every other setting
+    # both resolve; absent -> None -> run_darts_search's env/default chain
+    raw_window = settings.get("step_loop_window", settings.get("stepLoopWindow"))
     result = run_darts_search(
         dataset,
         primitives=primitives,
@@ -683,6 +797,24 @@ def darts_trial(ctx) -> None:
         # algorithm setting "fused": the fused mixed-op evaluation plan
         # (nas/darts/fused.py) — a Katib-style CR can request it
         fused=parse_bool(settings.get("fused")),
+        # device-resident step-loop knobs (the default path; setting
+        # step_loop=false pins eager stepping, an explicit true raises
+        # StepLoopUnavailable when the loop cannot engage)
+        step_loop=(
+            parse_bool(settings["step_loop"])
+            if "step_loop" in settings
+            else None
+        ),
+        step_loop_window=int(raw_window) if raw_window is not None else None,
+        # remat knobs ride the same spec surface as the batch-scaling
+        # harness (model.py DartsNetwork): remat=false skips recompute
+        # when HBM allows, remat_policy="dots" keeps matmul outputs
+        remat=parse_bool(settings.get("remat"), default=True),
+        remat_policy=(
+            str(settings["remat_policy"])
+            if settings.get("remat_policy") not in (None, "")
+            else None
+        ),
         # algorithm setting "search_augment": the reference's crop+flip
         # search transforms (run_trial.py:98-111); the fn selection lives
         # in run_darts_search so the env path and this one cannot diverge

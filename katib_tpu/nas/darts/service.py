@@ -45,6 +45,10 @@ DEFAULT_SETTINGS: dict[str, object] = {
 _POSITIVE_INT = {
     "num_epochs", "batch_size", "init_channels", "num_nodes",
     "stem_multiplier", "n_train", "n_test",
+    # scan-window of the device-resident step loop (search.py); the
+    # camelCase spelling is the Katib-style CR surface, the snake_case
+    # the internal one — both validate the same way
+    "step_loop_window", "stepLoopWindow",
 }
 # augment_epochs may be 0 (off, the default); validated separately below
 _NON_NEGATIVE_INT = {"augment_epochs"}

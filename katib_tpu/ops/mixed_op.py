@@ -1,0 +1,151 @@
+"""Fused softmax-weighted mixed-op contraction as a Pallas TPU kernel.
+
+The DARTS supernet's :class:`~katib_tpu.nas.darts.ops.MixedOp` ends in
+``einsum("o,onhwc->nhwc", weights, stacked)`` — a weighted sum over the
+stacked primitive outputs.  The AOT cost analysis puts that contraction's
+bytes-accessed term at the top of the supernet cell (the stacked tensor is
+``n_ops`` full activations wide), and at 0.55% MFU the search is bound by
+exactly this kind of bytes-over-FLOPs op.  This kernel fuses the weighting
+and the accumulation into ONE pass over the stacked tensor: each grid step
+streams an ``(n_ops, TILE)`` block through VMEM and contracts it against the
+``(1, n_ops)`` weight row on the MXU with f32 accumulation, so the stacked
+activations are read exactly once and no intermediate ``n_ops``-wide product
+is materialized in HBM.
+
+Exposure:
+
+- :func:`mixed_op_sum` is the public entry point; the backward pass is a
+  ``jax.custom_vjp`` in plain lax (two bandwidth-bound contractions XLA
+  already fuses well), so ``jax.grad``/``nn.vmap``/``lax.scan`` all compose
+  — the vmapped stacked-alpha MixedOp in ``nas/darts/model.py`` batches the
+  kernel through pallas_call's vmap rule.
+- ``KATIB_PALLAS_MIXED_OP`` selects the implementation:
+  ``auto`` (default) — compiled Pallas on TPU backends, lax reference
+  elsewhere (CPU numerics stay bit-identical to the pre-kernel einsum);
+  ``1``/``pallas`` — force the kernel (interpret mode off-TPU, so forcing
+  works everywhere); ``interpret`` — force ``interpret=True`` (the CPU test
+  path); ``0``/``lax`` — force the einsum reference.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# one (n_ops, TILE) block per grid step: at n_ops=8 / f32 that is ~16 KiB of
+# VMEM per operand block, far under budget, and 512 lanes keep the trailing
+# dim aligned to the (8, 128) f32 tile
+_TILE = 512
+
+_VALID_MODES = ("auto", "pallas", "interpret", "lax")
+
+
+def _mode() -> str:
+    raw = os.environ.get("KATIB_PALLAS_MIXED_OP", "auto").strip().lower()
+    if raw in ("", "auto"):
+        return "auto"
+    if raw in ("1", "true", "yes", "on", "pallas"):
+        return "pallas"
+    if raw == "interpret":
+        return "interpret"
+    if raw in ("0", "false", "no", "off", "lax"):
+        return "lax"
+    raise ValueError(
+        f"KATIB_PALLAS_MIXED_OP={raw!r} is not one of {_VALID_MODES}"
+    )
+
+
+def _lax_reference(weights: jnp.ndarray, stacked: jnp.ndarray) -> jnp.ndarray:
+    """The pre-kernel einsum, verbatim — the parity baseline and the default
+    on non-TPU backends (keeps CPU numerics bit-identical to the seed)."""
+    return jnp.einsum(
+        "o,o...->...", weights.astype(stacked.dtype), stacked
+    )
+
+
+def _kernel(w_ref, x_ref, o_ref):
+    # (1, n_ops) @ (n_ops, TILE) on the MXU, f32 accumulation regardless of
+    # the activation dtype (bf16 stacked inputs upcast per-block)
+    o_ref[...] = jnp.dot(
+        w_ref[...],
+        x_ref[...].astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    ).astype(o_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _pallas_mixed_op(weights, stacked, interpret):
+    return _pallas_fwd_impl(weights, stacked, interpret)
+
+
+def _pallas_fwd_impl(weights, stacked, interpret):
+    n_ops = stacked.shape[0]
+    out_shape = stacked.shape[1:]
+    m = math.prod(out_shape)
+    tile = min(_TILE, m)
+    # columns of the flattened activation are independent, so the padded
+    # tail of the last block is write-masked garbage we simply never read
+    out = pl.pallas_call(
+        _kernel,
+        grid=(pl.cdiv(m, tile),),
+        in_specs=[
+            pl.BlockSpec((1, n_ops), lambda i: (0, 0)),
+            pl.BlockSpec((n_ops, tile), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, tile), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((1, m), stacked.dtype),
+        interpret=interpret,
+    )(
+        weights.astype(jnp.float32).reshape(1, n_ops),
+        stacked.reshape(n_ops, m),
+    )
+    return out.reshape(out_shape)
+
+
+def _fwd(weights, stacked, interpret):
+    return _pallas_fwd_impl(weights, stacked, interpret), (weights, stacked)
+
+
+def _bwd(interpret, residuals, g):
+    weights, stacked = residuals
+    # backward in plain lax: dw is a full reduction over the activation
+    # (f32-accumulated), dx a rank-1 broadcast — both bandwidth-bound ops
+    # XLA fuses into neighbors, so a hand kernel buys nothing here
+    dw = jnp.einsum(
+        "o...,...->o",
+        stacked.astype(jnp.float32),
+        g.astype(jnp.float32),
+    ).astype(weights.dtype)
+    dx = (
+        weights.astype(g.dtype).reshape((-1,) + (1,) * g.ndim) * g[None]
+    ).astype(stacked.dtype)
+    return dw, dx
+
+
+_pallas_mixed_op.defvjp(_fwd, _bwd)
+
+
+def mixed_op_sum(weights: jnp.ndarray, stacked: jnp.ndarray) -> jnp.ndarray:
+    """``sum_o weights[o] * stacked[o]`` over the leading (op) axis.
+
+    ``weights``: ``(n_ops,)`` softmax over one edge's alphas.
+    ``stacked``: ``(n_ops, *activation)`` stacked primitive outputs.
+    Implementation selected by ``KATIB_PALLAS_MIXED_OP`` (module doc).
+    """
+    mode = _mode()
+    if mode == "lax":
+        return _lax_reference(weights, stacked)
+    if mode == "auto":
+        if jax.default_backend() == "tpu":
+            return _pallas_mixed_op(weights, stacked, False)
+        return _lax_reference(weights, stacked)
+    if mode == "pallas":
+        return _pallas_mixed_op(
+            weights, stacked, jax.default_backend() != "tpu"
+        )
+    return _pallas_mixed_op(weights, stacked, True)

@@ -613,6 +613,10 @@ async function counters(){
   const dh=m['katib_device_healthy'];
   const dhUp=dh?dh.samples.filter(x=>x.value>0).length:0;
   const dhAll=dh?dh.samples.length:0;
+  // steps-per-dispatch: the dispatch-overhead diagnostic for the DARTS
+  // step loop (window size under the scan loop, 1 under eager stepping)
+  const spdM=m['katib_steps_per_dispatch'];
+  const spd=spdM&&spdM.samples.length?spdM.samples[0].value:null;
   document.getElementById('counters').innerHTML=
     `<small>trials: ${tot('katib_trial_created_total')} created · `+
     `${tot('katib_trial_succeeded_total')} succeeded · `+
@@ -628,6 +632,7 @@ async function counters(){
     (tot('katib_drain_requested')?' · <b>DRAINING</b>':'')+
     (tot('katib_suggester_errors_total')?` · suggester errors: ${tot('katib_suggester_errors_total')}`:'')+
     (tot('katib_cohort_executed_total')?` · cohorts: ${tot('katib_cohort_executed_total')}`:'')+
+    (spd!==null?` · steps/dispatch: ${spd.toFixed(1)}${spd<=1?' <b>EAGER</b>':''}`:'')+
     (mean!==null?` · mean trial ${mean.toFixed(1)}s`:'')+'</small>';
 }
 async function refresh(){

@@ -406,6 +406,17 @@ device_hbm_bytes = REGISTRY.gauge(
     "katib_device_hbm_bytes_in_use",
     "Per-device bytes in use (jax device memory_stats, where available)",
 )
+step_loop_window = REGISTRY.gauge(
+    "katib_step_loop_window",
+    "Configured scan-window size of the device-resident DARTS step loop "
+    "(steps folded into one dispatch; 0 when the step loop is not engaged)",
+)
+steps_per_dispatch = REGISTRY.gauge(
+    "katib_steps_per_dispatch",
+    "Training steps executed per host dispatch in the most recent epoch "
+    "(window size under the device-resident step loop, 1 under eager "
+    "stepping — the first thing to check when MFU is low)",
+)
 
 # -- vectorized trial cohorts (runner/cohort.py) ------------------------------
 

@@ -14,9 +14,11 @@ script will submit it to the chip.  Missing AOT memo => the config is
 SKIPPED with a note, never attempted.
 
 Artifacts: ``artifacts/flagship/batch_scaling.json``.
-Env knobs: SCALING_CONFIGS (comma list like ``64:none,128:dots``; a third
-``:ph`` field adds the paired-Hessian step variant, e.g. ``128:dots:ph`` —
-its fit-proof is looked up under the matching ``_pairhess`` tag),
+Env knobs: SCALING_CONFIGS (comma list like ``64:none,128:dots``; extra
+``:``-separated variant fields select program variants — ``ph`` adds the
+paired-Hessian step (fit-proof looked up under the matching ``_pairhess``
+tag), ``w<N>`` runs the bench child's fused step loop with an N-step scan
+window (``BENCH_STEP_LOOP_WINDOW``), e.g. ``128:dots:ph:w8``),
 BENCH_STEPS per point (default 5).
 """
 
@@ -38,23 +40,28 @@ from _common import (  # noqa: E402
 RESULT_PREFIX = '{"metric"'
 
 
-def parse_configs(raw: str) -> list[tuple[int, str | None, bool]]:
-    out: list[tuple[int, str | None, bool]] = []
+def parse_configs(raw: str) -> list[tuple[int, str | None, bool, int | None]]:
+    out: list[tuple[int, str | None, bool, int | None]] = []
     for part in raw.split(","):
         fields = [f.strip() for f in part.strip().split(":")]
-        # fail fast on anything unrecognized: a typo'd config that silently
-        # parsed as the non-variant would burn a fit-proof-gated chip point
-        # on the wrong program and only surface after the window ends
-        if len(fields) > 3:
-            raise ValueError(f"SCALING_CONFIGS entry has >3 fields: {part!r}")
-        if len(fields) > 2 and fields[2] != "ph":
-            raise ValueError(
-                f"unknown variant field {fields[2]!r} in {part!r} (only 'ph')"
-            )
         batch = int(fields[0])
         policy = fields[1] if len(fields) > 1 and fields[1] not in ("", "none") else None
-        pairhess = len(fields) > 2
-        out.append((batch, policy, pairhess))
+        pairhess = False
+        window: int | None = None
+        # fail fast on anything unrecognized: a typo'd variant that silently
+        # parsed as the non-variant would burn a fit-proof-gated chip point
+        # on the wrong program and only surface after the window ends
+        for f in fields[2:]:
+            if f == "ph":
+                pairhess = True
+            elif len(f) > 1 and f[0] == "w" and f[1:].isdigit() and int(f[1:]) >= 1:
+                window = int(f[1:])
+            else:
+                raise ValueError(
+                    f"unknown variant field {f!r} in {part!r} "
+                    "(only 'ph' and 'w<N>')"
+                )
+        out.append((batch, policy, pairhess, window))
     return out
 
 
@@ -102,7 +109,10 @@ def main() -> int:
     # before the bench child even starts
     remote_compile = _local_compile_probe() is False
     points: list[dict] = []
-    for batch, policy, pairhess in configs:
+    for batch, policy, pairhess, window in configs:
+        # the scan window chunks dispatches of the SAME per-step program —
+        # donated carry, no extra live activations — so the fit-proof is
+        # keyed on (batch, policy, pairhess) only
         aot = aot_block_for(batch, policy, pairhess)
         if aot is None or not aot.get("hbm_fits_v5e"):
             points.append(
@@ -148,7 +158,15 @@ def main() -> int:
             env["BENCH_PAIRED_HESSIAN"] = "1"
         else:
             env.pop("BENCH_PAIRED_HESSIAN", None)
-        print(f"scaling: batch={batch} policy={policy} pairhess={pairhess} ...", flush=True)
+        if window is not None:
+            env["BENCH_STEP_LOOP_WINDOW"] = str(window)
+        else:
+            env.pop("BENCH_STEP_LOOP_WINDOW", None)
+        print(
+            f"scaling: batch={batch} policy={policy} pairhess={pairhess}"
+            f" window={window} ...",
+            flush=True,
+        )
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.join(REPO, "bench.py")],
@@ -186,20 +204,34 @@ def main() -> int:
             )
             _flush(points)
             continue
-        points.append(
-            {
-                "batch": batch,
-                "remat_policy": policy,
-                "paired_hessian": pairhess,
-                "images_per_sec": rec["value"],
-                "step_secs": rec["step_secs"],
-                "mfu": rec["mfu"],
-                "platform": rec["platform"],
-                "aot_hbm_gib": aot["hbm_gib"],
+        point = {
+            "batch": batch,
+            "remat_policy": policy,
+            "paired_hessian": pairhess,
+            "images_per_sec": rec["value"],
+            "step_secs": rec["step_secs"],
+            "mfu": rec["mfu"],
+            "platform": rec["platform"],
+            "aot_hbm_gib": aot["hbm_gib"],
+            "steps_per_dispatch": rec.get("steps_per_dispatch", 1),
+        }
+        fused = rec.get("fused_loop")
+        if fused is not None:
+            point["fused_loop"] = {
+                "images_per_sec": fused["value"],
+                "step_secs": fused["step_secs"],
+                "steps_per_dispatch": fused["steps_per_dispatch"],
+                "mfu": fused["mfu"],
             }
-        )
+        points.append(point)
         _flush(points)
         print(f"scaling:   -> {rec['value']} img/s ({rec['step_secs']}s/step)", flush=True)
+        if fused is not None:
+            print(
+                f"scaling:   -> fused x{fused['steps_per_dispatch']}: "
+                f"{fused['value']} img/s ({fused['step_secs']}s/step)",
+                flush=True,
+            )
 
     result = _flush(points)
     print(json.dumps(result["points"]), flush=True)

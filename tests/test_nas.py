@@ -233,6 +233,52 @@ class TestDartsModel:
         assert reports and all(0.0 <= r["accuracy"] <= 1.0 for r in reports)
         assert calls, "search_augment setting did not reach the epoch body"
 
+    def test_darts_trial_honors_step_loop_settings(self, tmp_path, monkeypatch):
+        """stepLoopWindow (the Katib-style CR spelling) flows from
+        algorithm-settings into the search: the windowed device-resident
+        step loop engages with the requested fold, observable on the
+        steps-per-dispatch gauge; remat=false rides the same surface."""
+        import json as _json
+
+        from katib_tpu.nas.darts.search import darts_trial
+        from katib_tpu.runner.context import TrialContext
+        from katib_tpu.utils import observability as obs
+
+        monkeypatch.delenv("KATIB_STEP_LOOP", raising=False)
+        monkeypatch.delenv("KATIB_STEP_LOOP_WINDOW", raising=False)
+
+        class Ctx:
+            params = {
+                "algorithm-settings": _json.dumps({
+                    "dataset": "digits", "n_train": "96", "n_test": "48",
+                    "num_epochs": "1", "batch_size": "16",
+                    "init_channels": "4", "num_nodes": "2",
+                    "stepLoopWindow": "2", "remat": "false",
+                }),
+                "search-space": _json.dumps(list(TINY_PRIMS)),
+                "num-layers": "2",
+            }
+            checkpoint_dir = str(tmp_path / "trial-sl")
+            mesh = None
+            _checkpointer = None
+
+            def report(self, **kw):
+                return True
+
+            def should_stop(self):
+                return False
+
+            ensure_checkpoint_dir = TrialContext.ensure_checkpoint_dir
+            checkpointer = TrialContext.checkpointer
+            save_checkpoint = TrialContext.save_checkpoint
+            restore_checkpoint = TrialContext.restore_checkpoint
+
+        darts_trial(Ctx())
+        # 48-sample w-split / batch 16 = 3 steps; window 2 -> dispatches of
+        # 2 + 1 steps = 1.5 steps per dispatch, window gauge reads 2
+        assert obs.step_loop_window.get(workload="darts") == 2.0
+        assert obs.steps_per_dispatch.get(workload="darts") == 1.5
+
     def test_search_resumes_from_checkpoint(self, tmp_path):
         """A restarted search picks up at the last completed epoch (flaky
         single-chip pools: a relay drop must not restart a long search)."""
@@ -574,36 +620,74 @@ class TestDeviceDataSearch:
         assert streamed["genotype"].normal == scanned["genotype"].normal
         assert streamed["genotype"].reduce == scanned["genotype"].reduce
 
-    def test_step_loop_matches_scan_path(self, monkeypatch):
-        """KATIB_STEP_LOOP=1 (device-resident splits, per-step dispatch of
-        the single-step program with an on-device gather) must reproduce
-        the scan path's trajectory: the mode exists so a pool whose
-        terminal-side compile of the epoch-sized scan program stalls can
-        still run the flagship off the cheap single-step compile — it must
-        change the dispatch granularity, not the math."""
+    def test_eager_escape_hatch_matches_step_loop(self, monkeypatch):
+        """KATIB_STEP_LOOP=0 (eager stepping: one dispatch per step of the
+        separately jitted single-step program with an on-device gather)
+        must reproduce the default windowed step loop's trajectory: the
+        escape hatch exists so a pool whose terminal-side compile of the
+        window-sized scan program stalls can still run the flagship off
+        the cheap single-step compile — it must change the dispatch
+        granularity, not the math."""
         from katib_tpu.models.data import synthetic_classification
         from katib_tpu.nas.darts.architect import DartsHyper
         from katib_tpu.nas.darts.search import run_darts_search
+        from katib_tpu.utils import observability as obs
 
         ds = synthetic_classification(96, 48, (12, 12, 3), 6, seed=0)
         kw = dict(
             num_layers=2, init_channels=4, n_nodes=2, num_epochs=2,
             batch_size=16, hyper=DartsHyper(unrolled=True), seed=3,
-            # augmentation ON so the step-loop's per-step aug_step +
+            # augmentation ON so the eager path's per-step aug_step +
             # fold_in(aug_key, state.step) keying is compared against the
             # scan body's in-jit fold — the claim that the mode changes
             # dispatch granularity, not math, includes the augment branch
             search_augment=True,
         )
         monkeypatch.delenv("KATIB_STEP_LOOP", raising=False)
-        scanned = run_darts_search(ds, device_data=True, **kw)
-        monkeypatch.setenv("KATIB_STEP_LOOP", "1")
+        looped = run_darts_search(ds, device_data=True, **kw)
+        # the default path IS the step loop: 3 steps/epoch, one dispatch
+        assert obs.steps_per_dispatch.get(workload="darts") == 3.0
+        monkeypatch.setenv("KATIB_STEP_LOOP", "0")
         stepped = run_darts_search(ds, device_data=True, **kw)
-        for a, b in zip(scanned["history"], stepped["history"]):
+        assert obs.steps_per_dispatch.get(workload="darts") == 1.0
+        assert obs.step_loop_window.get(workload="darts") == 0.0
+        for a, b in zip(looped["history"], stepped["history"]):
             assert a["val_accuracy"] == pytest.approx(b["val_accuracy"], abs=1e-5)
             assert a["train_loss"] == pytest.approx(b["train_loss"], rel=1e-4)
-        assert scanned["genotype"].normal == stepped["genotype"].normal
-        assert scanned["genotype"].reduce == stepped["genotype"].reduce
+        assert looped["genotype"].normal == stepped["genotype"].normal
+        assert looped["genotype"].reduce == stepped["genotype"].reduce
+
+    def test_explicit_step_loop_that_cannot_engage_raises(self, monkeypatch):
+        """An EXPLICITLY requested step loop that cannot engage must raise
+        StepLoopUnavailable with the reasons, not warn and run the slow
+        path (a silent fallback once burned a TPU window on the wrong
+        program shape); the same condition under the DEFAULT quietly runs
+        the eager path."""
+        from katib_tpu.models.data import synthetic_classification
+        from katib_tpu.nas.darts.architect import DartsHyper
+        from katib_tpu.nas.darts.search import (
+            StepLoopUnavailable,
+            run_darts_search,
+        )
+
+        ds = synthetic_classification(96, 48, (12, 12, 3), 6, seed=0)
+        kw = dict(
+            num_layers=2, init_channels=4, n_nodes=2, num_epochs=1,
+            batch_size=16, hyper=DartsHyper(unrolled=False), seed=3,
+        )
+        monkeypatch.setenv("KATIB_STEP_LOOP", "1")
+        with pytest.raises(StepLoopUnavailable, match="KATIB_DEVICE_DATA=0"):
+            monkeypatch.setenv("KATIB_DEVICE_DATA", "0")
+            run_darts_search(ds, **kw)
+        monkeypatch.delenv("KATIB_DEVICE_DATA")
+        # split smaller than one batch: explicit -> raise ...
+        small = synthetic_classification(24, 16, (8, 8, 3), 4, seed=0)
+        with pytest.raises(StepLoopUnavailable, match="smaller than one batch"):
+            run_darts_search(small, **{**kw, "batch_size": 16, "num_layers": 2})
+        # ... default -> quiet eager fallback (test below covers it too)
+        monkeypatch.delenv("KATIB_STEP_LOOP")
+        r = run_darts_search(small, **{**kw, "batch_size": 16})
+        assert r["genotype"] is not None
 
     def test_split_smaller_than_batch_falls_back(self):
         """A split smaller than one batch has zero full batches; the scan

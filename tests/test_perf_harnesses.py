@@ -30,16 +30,28 @@ def scaling():
 class TestBatchScalingGuards:
     def test_parse_configs(self, scaling):
         assert scaling.parse_configs("64:none,128:dots, 256:dots") == [
-            (64, None),
-            (128, "dots"),
-            (256, "dots"),
+            (64, None, False, None),
+            (128, "dots", False, None),
+            (256, "dots", False, None),
         ]
-        assert scaling.parse_configs("64") == [(64, None)]
+        assert scaling.parse_configs("64") == [(64, None, False, None)]
+
+    def test_parse_configs_variant_fields(self, scaling):
+        """'ph' and 'w<N>' compose in either order; anything else must
+        raise — a typo'd variant silently parsed as the plain program
+        would burn a chip point on the wrong measurement."""
+        assert scaling.parse_configs("128:dots:ph") == [(128, "dots", True, None)]
+        assert scaling.parse_configs("128:dots:w8") == [(128, "dots", False, 8)]
+        assert scaling.parse_configs("128:dots:ph:w8") == [(128, "dots", True, 8)]
+        assert scaling.parse_configs("128:dots:w8:ph") == [(128, "dots", True, 8)]
+        for bad in ("128:dots:hp", "128:dots:w0", "128:dots:wx", "128:dots:w"):
+            with pytest.raises(ValueError, match="variant field"):
+                scaling.parse_configs(bad)
 
     def test_known_configs_have_committed_aot_proofs(self, scaling):
         """The default study configs must be runnable: each carries a
         committed deviceless-AOT block that says it fits."""
-        for batch, policy in scaling.parse_configs("64:none,128:dots"):
+        for batch, policy, _ph, _w in scaling.parse_configs("64:none,128:dots"):
             blk = scaling.aot_block_for(batch, policy)
             assert blk is not None, (batch, policy)
             assert blk["hbm_fits_v5e"] is True
