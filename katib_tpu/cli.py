@@ -6,6 +6,7 @@ metric logs) with local commands over the orchestrator's status journal and
 observation store:
 
 - ``run <experiment.yaml>``   create + run an experiment to completion (--resume)
+- ``prewarm <experiment.yaml>``  compile the experiment's programs into the persistent cache
 - ``list``                    experiments in the workdir with live counts
 - ``describe <experiment>``   trials, assignments, observations, optimal, curve
 - ``metrics <trial>``         raw metric log for one trial
@@ -158,6 +159,93 @@ def cmd_run(args: argparse.Namespace) -> int:
         ):
             print(f"  {name} = {value}")
     return 0 if exp.condition.value != "Failed" else 1
+
+
+def cmd_prewarm(args: argparse.Namespace) -> int:
+    """Compile an experiment's programs into the persistent cache ahead of a
+    run: the fleet analog of the orchestrator's in-run prewarm worker.  Runs
+    meshless (single-host default placement) — sharded-mesh executables warm
+    in-run instead."""
+    from katib_tpu.compile.buckets import bucket_size
+    from katib_tpu.compile.prewarm import (
+        PrewarmRequest,
+        PrewarmWorker,
+        prewarm_fn_of,
+    )
+    from katib_tpu.compile.registry import REGISTRY, _structural
+    from katib_tpu.runner.cohort import cohort_fn_of
+    from katib_tpu.runner.trial_runner import init_compile_cache
+    from katib_tpu.sdk.yaml_spec import load_experiment_yaml
+
+    spec = load_experiment_yaml(args.experiment)
+    if spec.train_fn is None or prewarm_fn_of(spec.train_fn) is None:
+        print(
+            "error: the experiment's train_fn declares no prewarm twin "
+            "(see katib_tpu.compile.prewarm.attach_prewarm_fn)",
+            file=sys.stderr,
+        )
+        return 2
+    cache = init_compile_cache(spec.compile_cache)
+    if not cache:
+        print(
+            "note: no persistent compile cache wired (compileCache / "
+            "KATIB_COMPILE_CACHE) — prewarming helps only this process",
+            file=sys.stderr,
+        )
+    # shapes: parameters pinned to a single structural value join the
+    # signature; everything else rides the workload's own defaults (exactly
+    # what an unpinned sweep's signature carries at run time)
+    shared = {}
+    for p in spec.parameters:
+        try:
+            vals = p.grid_values()
+        except Exception:
+            continue  # unstepped double: runtime operand, not a shape
+        if len(vals) == 1 and _structural(vals[0]):
+            shared[p.name] = vals[0]
+    cohort_fn = cohort_fn_of(spec.train_fn)
+    if args.widths:
+        widths = sorted({max(1, int(w)) for w in args.widths.split(",")})
+    else:
+        # every padded width the orchestrator's grouping can produce: the
+        # singleton program plus (bucketed) cohort sizes up to cohortWidth
+        widths = {1}
+        if spec.cohort_width > 1 and cohort_fn is not None:
+            for size in range(2, spec.cohort_width + 1):
+                widths.add(bucket_size(size) if spec.cohort_buckets else size)
+        widths = sorted(widths)
+    worker = PrewarmWorker()
+    queued = 0
+    for k in widths:
+        req = PrewarmRequest(
+            train_fn=spec.train_fn,
+            shared=shared,
+            k=k,
+            program_fn=cohort_fn if k > 1 else None,
+        )
+        if worker.submit(req):
+            queued += 1
+        else:
+            print(f"k={k}: already registered (warm), skipped")
+    done = worker.drain(timeout=args.timeout)
+    worker.stop()
+    if not done:
+        print(
+            f"warning: timed out after {args.timeout}s with compiles still "
+            "queued (rerun to continue — finished work is cached)",
+            file=sys.stderr,
+        )
+    rows = [
+        [s["program"], s["k"], s.get("source", "?"), s.get("compile_seconds", "-")]
+        for s in sorted(REGISTRY.signatures(), key=lambda s: (s["program"], s["k"]))
+    ]
+    print(
+        f"prewarm: {queued} queued, {worker.compiled} compiled, "
+        f"{worker.failed} failed (cache: {cache or '<in-process only>'})"
+    )
+    if rows:
+        print(_table(rows, ["program", "k", "source", "compile_s"]))
+    return 0 if worker.failed == 0 and done else 1
 
 
 def cmd_list(args: argparse.Namespace) -> int:
@@ -1016,6 +1104,26 @@ def main(argv: list[str] | None = None) -> int:
         "(KATIB_PREFLIGHT_DEADLINE bounds it; see `katib-tpu doctor`)",
     )
     p.set_defaults(fn=cmd_run)
+
+    p = sub.add_parser(
+        "prewarm",
+        help="compile an experiment's programs into the persistent cache "
+        "ahead of a run (requires a train_fn with a prewarm twin)",
+    )
+    p.add_argument("experiment", help="experiment YAML")
+    p.add_argument(
+        "--widths",
+        default=None,
+        help="comma-separated cohort widths to warm (default: derived from "
+        "cohortWidth + shape bucketing)",
+    )
+    p.add_argument(
+        "--timeout",
+        type=float,
+        default=600.0,
+        help="max seconds to wait for queued compiles",
+    )
+    p.set_defaults(fn=cmd_prewarm)
 
     p = sub.add_parser("list", help="list experiments")
     p.add_argument("--workdir", default="katib_runs")

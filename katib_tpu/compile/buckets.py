@@ -1,0 +1,49 @@
+"""Cohort shape bucketing: quantize cohort width K onto few padded sizes.
+
+Every distinct stacked leading dimension K is a distinct XLA program — a
+sweep whose cohorts arrive as K=7, K=5, K=3 (tail groups, early-stopped
+members, elastic degradation) pays a full compile per width even though
+the members are byte-identical programs otherwise.  Rounding K up to the
+next power of two collapses those widths onto one executable: the extra
+rows are inert ghost members (they train on member 0's hyperparameters
+and their metric rows never reach the store — ``runner/cohort.py``), so
+the padding costs FLOPs that were already idle, not correctness.
+
+The trial mesh axis interacts: a sharded cohort must carry a member count
+divisible by the trial-axis size D, so a bucket is the power of two
+rounded up to a multiple of D.  With D itself a power of two (device
+counts are), the bucket set is simply {D, 2D, 4D, ...} ∪ {1, 2, ..., D}.
+"""
+
+from __future__ import annotations
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= ``n`` (1 for n <= 1)."""
+    if n <= 1:
+        return 1
+    return 1 << (n - 1).bit_length()
+
+
+def bucket_size(k: int, multiple: int = 1) -> int:
+    """The padded bucket for a K-member cohort: next power of two, then
+    rounded up to a multiple of ``multiple`` (the trial-axis size)."""
+    if k < 1:
+        raise ValueError(f"cohort width must be >= 1, got {k}")
+    m = max(int(multiple), 1)
+    b = next_pow2(k)
+    return -(-b // m) * m
+
+
+def bucketed_cohort_size(k: int, mesh=None) -> int:
+    """Mesh-aware :func:`bucket_size` — the bucketed twin of
+    ``parallel.mesh.padded_cohort_size`` (which pads to the trial-axis
+    multiple only)."""
+    from katib_tpu.parallel.mesh import trial_axis_size
+
+    return bucket_size(k, trial_axis_size(mesh))
+
+
+def bucket_table(max_k: int, multiple: int = 1) -> list[tuple[int, int]]:
+    """The K -> bucket mapping for widths 1..max_k (docs/tests/CLI view)."""
+    return [(k, bucket_size(k, multiple)) for k in range(1, max_k + 1)]

@@ -1,0 +1,278 @@
+"""Compile-signature registry: what has been compiled, and was it warm?
+
+A *compile signature* is the coarse identity of a jitted trial program:
+which train function, which structural hyperparameters (the ones baked
+into the trace — model widths, batch sizes, optimizer family), the padded
+cohort width K, the mesh layout, and whether the carried state is donated.
+Two executions with the same signature trace the same program, so the
+second one should hit the in-process jit cache or the persistent XLA
+compilation cache (``init_compile_cache``) instead of recompiling.
+
+The registry records every signature compiled (by trials, by the prewarm
+worker, by the CLI ``prewarm`` verb) and classifies each trial's first
+step warm/cold against it, exporting
+``katib_compile_cache_hits_total`` / ``katib_compile_cache_misses_total``
+and the warm-vs-cold ``katib_first_step_compile_seconds`` histogram so a
+cache regression shows up as the miss counter climbing.
+
+When the persistent compilation cache is wired, signatures also persist
+to ``<cache_dir>/shape_registry.jsonl`` — a prewarm subprocess (or an
+earlier run of the same sweep) warms classification for later processes
+sharing the cache directory.  Everything here is best-effort telemetry:
+an unreadable registry file, an unhashable value, or a full disk never
+fails a trial.
+
+Classification heuristics (documented, deliberate):
+
+- float-valued parameters are excluded from the signature — the model
+  fns in this repo carry lr/momentum as runtime operands
+  (``optax.inject_hyperparams``), so floats don't change the program;
+- cohort signatures use only the parameters every member agrees on
+  (per-member varying values are runtime rows by construction);
+- over-keying (a shared float that *doesn't* change the program) errs
+  toward classifying cold — conservative, never falsely warm.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Mapping, Sequence
+
+from katib_tpu.utils import observability as obs
+
+_REGISTRY_FILENAME = "shape_registry.jsonl"
+
+
+def _program_name(fn: Callable | None) -> str:
+    if fn is None:
+        return "<none>"
+    return getattr(fn, "__qualname__", getattr(fn, "__name__", repr(fn)))
+
+
+def mesh_signature(mesh: Any) -> str:
+    """Stable cross-process mesh identity: axis layout + platform (device
+    ids are process-local and recycle; the compiled program depends on the
+    shape of the mesh, not which physical chips back it)."""
+    if mesh is None:
+        return ""
+    try:
+        axes = ",".join(f"{n}={s}" for n, s in mesh.shape.items())
+        platform = next(iter(mesh.devices.flat)).platform
+        return f"{axes}:{platform}"
+    except Exception:
+        return repr(mesh)
+
+
+def _structural(value: Any) -> bool:
+    """True for values baked into the trace (ints, strs, bools); floats ride
+    as runtime operands through inject_hyperparams and are excluded."""
+    return isinstance(value, (int, str, bool)) and not isinstance(value, float)
+
+
+@dataclass(frozen=True)
+class CompileSignature:
+    """Coarse identity of one compiled trial program."""
+
+    program: str
+    shapes: tuple[tuple[str, str], ...] = ()
+    k: int = 1
+    mesh: str = ""
+    donation: bool = True
+
+    def key(self) -> str:
+        return json.dumps(
+            {
+                "program": self.program,
+                "shapes": list(self.shapes),
+                "k": self.k,
+                "mesh": self.mesh,
+                "donation": self.donation,
+            },
+            sort_keys=True,
+        )
+
+
+def shared_structural(param_dicts: Sequence[Mapping[str, Any]]) -> dict[str, Any]:
+    """Structural parameters every member agrees on — the signature's shape
+    component.  Per-member varying values (lr, momentum, seeds) drop out
+    here exactly because they vary: they are runtime rows, not trace
+    constants."""
+    if not param_dicts:
+        return {}
+    out: dict[str, Any] = {}
+    first = param_dicts[0]
+    for name, value in first.items():
+        if not _structural(value):
+            continue
+        if all(p.get(name) == value for p in param_dicts[1:]):
+            out[name] = value
+    return out
+
+
+def _shapes_of(shared: Mapping[str, Any]) -> tuple[tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in shared.items()))
+
+
+def cohort_signature(
+    cohort_fn: Callable | None,
+    trials: Sequence[Any],
+    k: int,
+    mesh: Any = None,
+) -> CompileSignature:
+    """Signature of a cohort execution: the cohort twin's program, the
+    member-agreed structural parameters, and the padded/bucketed width
+    ``k`` the stacked state will actually carry."""
+    params = [t.params() for t in trials]
+    return CompileSignature(
+        program=_program_name(cohort_fn),
+        shapes=_shapes_of(shared_structural(params)),
+        k=int(k),
+        mesh=mesh_signature(mesh),
+    )
+
+
+def trial_signature(train_fn: Callable | None, trial: Any, mesh: Any = None) -> CompileSignature:
+    """Signature of a singleton white-box trial (k=1)."""
+    params = trial.params()
+    shared = {n: v for n, v in params.items() if _structural(v)}
+    return CompileSignature(
+        program=_program_name(train_fn),
+        shapes=_shapes_of(shared),
+        k=1,
+        mesh=mesh_signature(mesh),
+    )
+
+
+def _cache_dir() -> str | None:
+    """The wired persistent-compile-cache dir, or None — read from the live
+    jax config (set by ``init_compile_cache``) so a prewarm subprocess with
+    the same env shares the registry file without an import cycle."""
+    try:
+        import jax
+
+        d = getattr(jax.config, "jax_compilation_cache_dir", None)
+        return str(d) if d else None
+    except Exception:
+        return None
+
+
+class ShapeRegistry:
+    """Thread-safe compiled-signature set with optional JSONL persistence."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._seen: dict[str, dict] = {}
+        self._loaded_dir: str | None = None
+
+    # -- persistence (best-effort) ----------------------------------------
+
+    def _path(self) -> str | None:
+        d = _cache_dir()
+        return os.path.join(d, _REGISTRY_FILENAME) if d else None
+
+    def _maybe_load(self) -> None:
+        """Lazily fold the cache dir's registry file into memory, once per
+        directory (a later init_compile_cache of a different dir reloads)."""
+        d = _cache_dir()
+        if d is None or d == self._loaded_dir:
+            return
+        self._loaded_dir = d
+        path = os.path.join(d, _REGISTRY_FILENAME)
+        try:
+            with open(path) as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    key = rec.get("key")
+                    if key:
+                        self._seen.setdefault(key, rec)
+        except OSError:
+            pass
+
+    def _append(self, rec: dict) -> None:
+        path = self._path()
+        if path is None:
+            return
+        try:
+            with open(path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        except OSError:
+            pass  # registry persistence is telemetry, never a failure
+
+    # -- the registry proper ----------------------------------------------
+
+    def seen(self, sig: CompileSignature) -> bool:
+        with self._lock:
+            self._maybe_load()
+            return sig.key() in self._seen
+
+    def record(
+        self,
+        sig: CompileSignature,
+        source: str = "trial",
+        compile_seconds: float | None = None,
+    ) -> bool:
+        """Record a compiled signature; returns True when it was new."""
+        key = sig.key()
+        rec = {
+            "key": key,
+            "program": sig.program,
+            "k": sig.k,
+            "mesh": sig.mesh,
+            "shapes": dict(sig.shapes),
+            "donation": sig.donation,
+            "source": source,
+        }
+        if compile_seconds is not None:
+            rec["compile_seconds"] = round(float(compile_seconds), 4)
+        with self._lock:
+            self._maybe_load()
+            fresh = key not in self._seen
+            if fresh:
+                self._seen[key] = rec
+        if fresh:
+            self._append(rec)
+        return fresh
+
+    def classify(self, sig: CompileSignature) -> str:
+        """``"warm"`` when the signature was compiled before (this process
+        or a registry-sharing one), else ``"cold"`` — no counter side
+        effects (see :meth:`note_first_step`)."""
+        return "warm" if self.seen(sig) else "cold"
+
+    def note_first_step(
+        self, sig: CompileSignature, seconds: float, source: str = "trial"
+    ) -> str:
+        """Classify a first step warm/cold, bump the hit/miss counters,
+        feed the warm-vs-cold histogram, and record the signature so the
+        next same-shape execution classifies warm.  Returns the label."""
+        label = self.classify(sig)
+        if label == "warm":
+            obs.compile_cache_hits.inc(program=sig.program)
+        else:
+            obs.compile_cache_misses.inc(program=sig.program)
+        try:
+            obs.first_step_compile_seconds.observe(float(seconds), cache=label)
+        except (TypeError, ValueError):
+            pass
+        self.record(sig, source=source, compile_seconds=seconds)
+        return label
+
+    def signatures(self) -> list[dict]:
+        with self._lock:
+            self._maybe_load()
+            return list(self._seen.values())
+
+    def reset(self) -> None:
+        """Forget everything (tests); the on-disk file is left alone."""
+        with self._lock:
+            self._seen.clear()
+            self._loaded_dir = None
+
+
+REGISTRY = ShapeRegistry()

@@ -676,6 +676,19 @@ class ExperimentSpec:
     # (PBT generations, Hyperband rungs).  None = only labeled proposals
     # group into cohorts.
     cohort_key: str | None = None
+    # Cohort shape bucketing: pad each cohort's member axis up to the next
+    # power of two (x trial-axis multiple) instead of the exact width, so
+    # heterogeneous cohort sizes collapse onto a handful of cached
+    # executables — ghost members make the extra rows free
+    # (katib_tpu/compile/buckets.py).  Only affects orchestrator-driven
+    # cohorts; the direct run_cohort API defaults to exact padding.
+    cohort_buckets: bool = True
+    # Background compile prewarm: while trials run, a best-effort daemon
+    # worker compiles upcoming groups' programs (via the train_fn's prewarm
+    # twin, see compile.prewarm.attach_prewarm_fn) into the jit + persistent
+    # caches so their first step deserializes instead of recompiling.
+    # No-op for train_fns without a prewarm twin; never fails a trial.
+    prewarm: bool = True
     # Persistent XLA compilation-cache directory wired at run() start
     # (jax_compilation_cache_dir); None falls back to the
     # KATIB_COMPILE_CACHE env var, empty/unset disables.

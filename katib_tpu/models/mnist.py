@@ -511,8 +511,122 @@ def mnist_trial(ctx) -> None:
     )
 
 
+def mnist_prewarm(shared: dict, k: int, mesh=None) -> None:
+    """Compile-only twin of ``mnist_trial``/``mnist_cohort_trial`` (see
+    ``compile.prewarm.attach_prewarm_fn``): builds the exact jitted step
+    objects the real run will pull from ``_STEP_CACHE`` and runs them once
+    on dummy operands of the right shapes/dtypes, so the trial's first step
+    hits the in-process jit cache (and, with ``init_compile_cache`` wired,
+    the persistent XLA cache) instead of tracing + compiling.
+
+    Dataset-free by design — prewarm must not trigger dataset loads; MNIST
+    shapes are static (28, 28, 1) and the loaders produce float32/int32,
+    so zeros of the right aval compile the identical program.  Mirrors the
+    real paths' branching: ``k > 1`` warms the vmapped cohort step (trial
+    sharding when the mesh carries a trial axis), ``k == 1`` warms either
+    the device-data epoch scan or the streamed per-batch step, matching
+    ``train_classifier``'s own mode selection."""
+    p = dict(shared)
+    arch = str(p.get("arch", "mlp"))
+    if arch == "cnn":
+        model = SmallCNN(channels=int(p.get("channels", 32)))
+    else:
+        model = MLP(
+            units=int(p.get("units", 64)), num_layers=int(p.get("num_layers", 2))
+        )
+    n_train = int(p.get("n_train", 4096))
+    n_test = int(p.get("n_test", 1024))
+    batch_size = int(p.get("batch_size", 256))
+    optimizer = str(p.get("optimizer", "momentum"))
+    shape = (28, 28, 1)  # load_mnist's static input_shape
+    k = int(k)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, *shape), jnp.float32)
+    )
+    xb = jnp.zeros((batch_size, *shape), jnp.float32)
+    yb = jnp.zeros((batch_size,), jnp.int32)
+    ne = min(1024, n_test)
+
+    if k > 1:
+        from katib_tpu.parallel.mesh import replicate, shard_members, trial_axis_size
+
+        # cohort_mesh semantics: no trial axis -> single-device vmap
+        cmesh = mesh if (mesh is not None and trial_axis_size(mesh) > 1) else None
+        tx, step, evaluate = _cohort_steps_for(model, optimizer, cmesh)
+        base = TrainState.create(params, tx)
+        state = stack_pytrees([base] * k)
+        # hyperparameter VALUES are runtime rows — any finite placeholder
+        # compiles the same program the real assignments will run
+        hp = dict(state.opt_state.hyperparams)
+        hp["learning_rate"] = jnp.full((k,), 0.05, jnp.float32)
+        if "momentum" in hp:
+            hp["momentum"] = jnp.full((k,), 0.9, jnp.float32)
+        state = state._replace(opt_state=state.opt_state._replace(hyperparams=hp))
+        xe = jnp.zeros((ne, *shape), jnp.float32)
+        ye = jnp.zeros((ne,), jnp.int32)
+        if cmesh is not None:
+            state = shard_members(state, cmesh)
+            batch = replicate((xb, yb), cmesh)
+            ebatch = replicate((xe, ye), cmesh)
+        else:
+            batch = (xb, yb)
+            ebatch = (xe, ye)
+        state, _ = step(state, batch)
+        em = evaluate(state.params, ebatch)
+    else:
+        import os
+
+        from katib_tpu.utils.booleans import parse_bool
+
+        tx, step, evaluate, scan_epoch, _aug = _steps_for(model, optimizer, mesh)
+        state = TrainState.create(params, tx)
+        state = state._replace(
+            opt_state=_set_hyperparams(state.opt_state, 0.05, 0.9)
+        )
+        if mesh is not None:
+            from katib_tpu.parallel.mesh import replicate
+
+            state = replicate(state, mesh)
+        env = os.environ.get("KATIB_DEVICE_DATA")
+        device_data = mesh is None if env is None else parse_bool(env)
+        scan_steps = n_train // batch_size
+        if device_data and mesh is None and scan_steps >= 1:
+            state, _ = scan_epoch(
+                state,
+                jnp.zeros((n_train, *shape), jnp.float32),
+                jnp.zeros((n_train,), jnp.int32),
+                jnp.zeros((scan_steps, batch_size), jnp.int32),
+                jax.random.PRNGKey(0),
+            )
+        else:
+            batch = (xb, yb) if mesh is None else shard_batch((xb, yb), mesh)
+            state, _ = step(state, batch)
+        # eval prefix: same truncate/tile placement as train_classifier
+        xe = np.zeros((ne, *shape), np.float32)
+        ye = np.zeros((ne,), np.int32)
+        if mesh is not None:
+            from katib_tpu.parallel.mesh import DATA_AXIS, local_mesh_size
+
+            d = local_mesh_size(mesh, DATA_AXIS)
+            if ne >= d:
+                xe, ye = xe[: (ne // d) * d], ye[: (ne // d) * d]
+            elif ne > 0:
+                reps = -(-d // ne)
+                xe = np.tile(xe, (reps,) + (1,) * (xe.ndim - 1))[:d]
+                ye = np.tile(ye, reps)[:d]
+            ebatch = shard_batch((xe, ye), mesh)
+        else:
+            ebatch = jax.device_put((xe, ye))
+        em = evaluate(state.params, ebatch)
+    em["accuracy"].block_until_ready()
+
+
 # opt-in: the orchestrator batches compatible mnist_trial proposals through
-# the vmapped twin when the experiment declares a cohort (runner/cohort.py)
+# the vmapped twin when the experiment declares a cohort (runner/cohort.py),
+# and the prewarm worker compiles upcoming groups' programs in the
+# background through the compile-only twin (compile/prewarm.py)
+from katib_tpu.compile.prewarm import attach_prewarm_fn  # noqa: E402
 from katib_tpu.runner.cohort import attach_cohort_fn  # noqa: E402
 
 attach_cohort_fn(mnist_trial, mnist_cohort_trial)
+attach_prewarm_fn(mnist_trial, mnist_prewarm)

@@ -72,15 +72,34 @@ def _record_first_step(compile_s: float, execute_s: float, workload: str) -> Non
     """First-step latency split: under async dispatch the first jitted call
     blocks on trace+compile, fetching its result blocks on execution.  With
     the persistent compilation cache wired (KATIB_COMPILE_CACHE), a cache
-    hit shows up here as the compile phase collapsing to deserialize time."""
-    obs.trial_first_step_seconds.set(compile_s, phase="compile", workload=workload)
-    obs.trial_first_step_seconds.set(execute_s, phase="execute", workload=workload)
+    hit shows up here as the compile phase collapsing to deserialize time.
+
+    Warm/cold labeling goes through the shape registry with a coarse
+    per-workload signature — classify + record only, NO hit/miss counters:
+    orchestrator-driven darts trials already count once at the runner's
+    first-step seam, and a double bump would overstate the hit rate."""
+    from katib_tpu.compile.registry import REGISTRY, CompileSignature
+
+    cache = "unknown"
+    try:
+        sig = CompileSignature(program=f"darts:{workload}")
+        cache = REGISTRY.classify(sig)
+        REGISTRY.record(sig, source="darts", compile_seconds=compile_s)
+    except Exception:
+        pass  # classification is telemetry, never a search failure
+    obs.trial_first_step_seconds.set(
+        compile_s, phase="compile", cache=cache, workload=workload
+    )
+    obs.trial_first_step_seconds.set(
+        execute_s, phase="execute", cache=cache, workload=workload
+    )
     tracing.record_span(
         "first_step",
         compile_s + execute_s,
         workload=workload,
         compile_s=round(compile_s, 4),
         execute_s=round(execute_s, 4),
+        cache=cache,
         persistent_cache=_persistent_cache_dir(),
     )
 

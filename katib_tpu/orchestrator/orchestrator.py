@@ -107,6 +107,8 @@ class Orchestrator:
         # jax.profiler is a process-global singleton; only one trial may
         # trace at a time — others run unprofiled rather than crash
         self._profile_lock = threading.Lock()
+        # per-run background compile prewarmer (katib_tpu/compile/prewarm.py)
+        self._prewarm = None
         # external stop request (client delete / shutdown): sticky so a stop
         # issued before run() enters its loop is not lost; each run() has its
         # own wind-down event for in-flight trials
@@ -271,6 +273,15 @@ class Orchestrator:
         self.drained = False
         obs.drain_requested.set(1.0 if self._drain_requested.is_set() else 0.0)
         self._watchdog = Watchdog()
+        # background compile prewarmer (katib_tpu/compile/): fed with each
+        # upcoming group's shape signature below, stopped in the finally —
+        # strictly best-effort, a dead worker only means cold first steps
+        if spec.prewarm:
+            from katib_tpu.compile.prewarm import PrewarmWorker
+
+            self._prewarm = PrewarmWorker()
+        else:
+            self._prewarm = None
 
         # a bad mesh config must still settle the experiments_current gauge
         # and the status journal before surfacing
@@ -395,6 +406,11 @@ class Orchestrator:
                                 self._materialize(exp, p, early_stopper, suggester)
                                 for p in group
                             ]
+                            # queue the group's compile signature on the
+                            # prewarm worker: while the pool is busy with
+                            # earlier cohorts, this group's program compiles
+                            # in the background so its first step is warm
+                            self._submit_prewarm(spec, trials, mesh)
                             if len(trials) == 1:
                                 futures[
                                     pool.submit(self._execute, exp, trials[0], mesh)
@@ -464,6 +480,11 @@ class Orchestrator:
             watchdog, self._watchdog = self._watchdog, None
             if watchdog is not None:
                 watchdog.stop()
+            # wind down the prewarm worker (bounded; an in-flight compile is
+            # abandoned on its daemon thread — nothing waits on it)
+            prewarm, self._prewarm = self._prewarm, None
+            if prewarm is not None:
+                prewarm.stop()
             # final durable-state write so a completed-then-reopened
             # experiment (raised max_trial_count) resumes the suggester too
             self._persist_suggester(exp, suggester)
@@ -608,6 +629,49 @@ class Orchestrator:
                 groups.append(bucket[i : i + width])
         return groups
 
+    def _submit_prewarm(self, spec: ExperimentSpec, trials: list[Trial], mesh) -> None:
+        """Enqueue one group's compile signature on the prewarm worker.
+        Best-effort and non-blocking: no worker, no prewarm twin, a full
+        queue, or an already-registered signature all silently no-op, and
+        nothing here may fail the submit path."""
+        worker = self._prewarm
+        if worker is None:
+            return
+        try:
+            from katib_tpu.compile.buckets import bucketed_cohort_size
+            from katib_tpu.compile.prewarm import PrewarmRequest
+            from katib_tpu.compile.registry import shared_structural
+            from katib_tpu.parallel.mesh import padded_cohort_size, trial_axis_size
+
+            sig_mesh = mesh
+            if len(trials) > 1:
+                # mirror CohortContext.padded_size / cohort_mesh so the
+                # prewarmed signature matches the one run_cohort classifies
+                # against (a mesh without a trial axis runs cohorts as a
+                # single-device vmap — cohort_mesh is None there)
+                k = (
+                    bucketed_cohort_size(len(trials), mesh)
+                    if spec.cohort_buckets
+                    else padded_cohort_size(len(trials), mesh)
+                )
+                program_fn = cohort_fn_of(spec.train_fn)
+                if trial_axis_size(mesh) <= 1:
+                    sig_mesh = None
+            else:
+                k = 1
+                program_fn = None
+            worker.submit(
+                PrewarmRequest(
+                    train_fn=spec.train_fn,
+                    shared=shared_structural([t.params() for t in trials]),
+                    k=k,
+                    mesh=sig_mesh,
+                    program_fn=program_fn,
+                )
+            )
+        except Exception:
+            pass  # prewarm must never take down the submit loop
+
     def _execute_cohort(self, exp: Experiment, trials: list[Trial], mesh):
         """Run a cohort on one pool thread; returns ``{name: TrialResult}``.
         Never raises (harvest calls ``f.result()`` bare).
@@ -627,6 +691,7 @@ class Orchestrator:
                     injector=self.fault_injector,
                     watchdog=self._watchdog,
                     drain_event=self._drain_event,
+                    buckets=exp.spec.cohort_buckets,
                 )
             except Exception as e:  # defense: run_cohort itself never raises
                 results = {

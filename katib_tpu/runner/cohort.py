@@ -79,6 +79,7 @@ class CohortContext:
         drain_event: threading.Event | None = None,
         hang_event: threading.Event | None = None,
         heartbeat: Any = None,
+        buckets: bool = False,
     ):
         self.members = list(members)
         self.params_list = [t.params() for t in self.members]
@@ -94,6 +95,10 @@ class CohortContext:
             self.trial_devices = trial_axis_size(mesh)
         else:
             self.trial_devices = 1
+        # shape bucketing (ExperimentSpec.cohort_buckets): quantize the
+        # padded member dimension to the next power of two so cohorts of
+        # heterogeneous K share one cached executable (katib_tpu/compile)
+        self.buckets = buckets
         self._store = store
         self._objective = objective
         self._stop_event = stop_event
@@ -129,10 +134,16 @@ class CohortContext:
     def padded_size(self) -> int:
         """K rounded up to a multiple of the trial-axis size — the leading
         dimension the stacked state pytree must carry on a sharded mesh.
+        With ``buckets`` on, K is first quantized to the next power of two
+        so different-K cohorts collapse onto one cached executable.
         Rows ``[K:]`` are ghost members: they train (on member 0's
         hyperparameters, so they stay finite) but their metric rows are
         dropped by ``report`` before the ObservationStore."""
         t = self.trial_devices
+        if self.buckets:
+            from katib_tpu.compile.buckets import bucket_size
+
+            return bucket_size(len(self.members), t)
         return -(-len(self.members) // t) * t
 
     @property
@@ -329,6 +340,7 @@ def run_cohort(
     injector=None,
     watchdog=None,
     drain_event: threading.Event | None = None,
+    buckets: bool = False,
 ) -> dict[str, TrialResult]:
     """Execute K trials as one vectorized cohort; returns a per-trial-name
     result map.  Never raises: a cohort-path failure falls back to serial
@@ -405,7 +417,27 @@ def run_cohort(
         compile_hang_event.set()
         hang_event.set()  # cooperative unwind through the hang path
 
+    # warm/cold first-step classification: the cohort's first step-boundary
+    # report closes the trace+compile+first-dispatch window; the shape
+    # registry (katib_tpu/compile) decides whether that compile should have
+    # hit the cache and feeds the hit/miss counters
+    from katib_tpu.compile import registry as compile_registry
+
+    sig_holder: list = [None]
+    first_step_at: list[float] = [0.0]
+
     def _beat() -> None:
+        sig = sig_holder[0]
+        if sig is not None:
+            sig_holder[0] = None
+            try:
+                dt = time.perf_counter() - first_step_at[0]
+                label = compile_registry.REGISTRY.note_first_step(sig, dt)
+                obs.trial_first_step_seconds.set(
+                    dt, phase="first_report", cache=label, workload=sig.program
+                )
+            except Exception:
+                pass  # classification is telemetry, never a cohort failure
         hb = compile_hb_holder[0]
         if hb is not None:
             # first step-boundary report = first dispatch done
@@ -430,9 +462,10 @@ def run_cohort(
             ctx = CohortContext(
                 survivors, store, objective, mesh=cur_mesh, stop_event=stop_event,
                 drain_event=drain_event, hang_event=hang_event,
-                heartbeat=(
-                    _beat if (heartbeat is not None or compile_deadlines) else None
-                ),
+                # always wired: _beat also closes the warm/cold first-step
+                # classification window above
+                heartbeat=_beat,
+                buckets=buckets,
             )
             devices = ctx.trial_devices
             if watchdog is not None and compile_deadlines:
@@ -446,6 +479,12 @@ def run_cohort(
                     injector.on_cohort_execute(
                         survivors, [d.id for d in cur_mesh.devices.flat]
                     )
+                # (re)arm classification per tier — a rebuilt mesh means a
+                # fresh program with its own signature
+                sig_holder[0] = compile_registry.cohort_signature(
+                    cohort_fn, survivors, ctx.padded_size, ctx.cohort_mesh
+                )
+                first_step_at[0] = time.perf_counter()
                 with tracing.span(
                     "cohort",
                     size=k,

@@ -68,6 +68,22 @@ def init_compile_cache(cache_dir: str | None = None) -> str | None:
     """
     global _COMPILE_CACHE_DIR
     if _COMPILE_CACHE_DIR is not None:
+        requested = os.environ.get("KATIB_COMPILE_CACHE") or cache_dir
+        if requested and os.path.abspath(requested) != _COMPILE_CACHE_DIR:
+            # first caller wins (the jax config is process-global), but a
+            # second experiment asking for a DIFFERENT directory deserves to
+            # know its setting is inert — its executables land in (and hit
+            # from) the first directory
+            import warnings
+
+            warnings.warn(
+                "persistent compilation cache already wired to "
+                f"{_COMPILE_CACHE_DIR!r}; ignoring the requested "
+                f"{os.path.abspath(requested)!r} (the jax cache config is "
+                "process-global — first caller wins)",
+                RuntimeWarning,
+                stacklevel=2,
+            )
         return _COMPILE_CACHE_DIR
     resolved = os.environ.get("KATIB_COMPILE_CACHE") or cache_dir
     if not resolved:
@@ -219,7 +235,34 @@ def _run_whitebox(
             on_hang=_on_compile_hang,
         )
 
+    # warm/cold first-step classification: the first ctx.report() marks the
+    # first step boundary (trace + compile + first dispatch behind it); the
+    # shape registry decides whether that compile should have been a cache
+    # hit and feeds the hit/miss counters + warm-vs-cold histogram
+    from katib_tpu.compile import registry as compile_registry
+
+    first_step_sig = compile_registry.trial_signature(
+        trial.spec.train_fn, trial, mesh
+    )
+    started_holder = [time.perf_counter()]
+    first_step_seen = [False]
+
     def _beat() -> None:
+        if not first_step_seen[0]:
+            first_step_seen[0] = True
+            try:
+                dt = time.perf_counter() - started_holder[0]
+                label = compile_registry.REGISTRY.note_first_step(
+                    first_step_sig, dt
+                )
+                obs.trial_first_step_seconds.set(
+                    dt,
+                    phase="first_report",
+                    cache=label,
+                    workload=first_step_sig.program,
+                )
+            except Exception:
+                pass  # classification is telemetry, never a trial failure
         if compile_hb is not None:
             # first metric report = first dispatch completed: compile is done
             compile_hb.close()
@@ -238,7 +281,9 @@ def _run_whitebox(
         max_runtime_seconds=trial.spec.max_runtime_seconds,
         drain_event=drain_event,
         hang_event=hang_event,
-        heartbeat=_beat if (heartbeat is not None or compile_hb is not None) else None,
+        # always wired: _beat also timestamps the first step boundary for
+        # the warm/cold classification above
+        heartbeat=_beat,
     )
 
     def _deadline_result() -> TrialResult:
@@ -278,6 +323,7 @@ def _run_whitebox(
             # did decides the settlement (HANG / KILLED / DRAINED)
             injector.maybe_hang(trial, events=(hang_event, stop_event, drain_event))
             ctx.raise_if_stopped()
+        started_holder[0] = time.perf_counter()  # first-step clock starts here
         with tracing.span("train_fn", trial=trial.name):
             trial.spec.train_fn(ctx)
     except TrialEarlyStopped as e:

@@ -353,6 +353,8 @@ def experiment_spec_from_dict(data: Mapping[str, Any]) -> ExperimentSpec:
         cohort_key=(
             str(spec["cohortKey"]) if spec.get("cohortKey") is not None else None
         ),
+        cohort_buckets=bool(spec.get("cohortBuckets", True)),
+        prewarm=bool(spec.get("prewarm", True)),
         compile_cache=(
             str(spec["compileCache"]) if spec.get("compileCache") is not None else None
         ),

@@ -448,6 +448,30 @@ compile_cache_enabled = REGISTRY.gauge(
     "(KATIB_COMPILE_CACHE / ExperimentSpec.compile_cache)",
 )
 
+# -- compile amortization (katib_tpu/compile/) --------------------------------
+
+compile_cache_hits = REGISTRY.counter(
+    "katib_compile_cache_hits_total",
+    "First steps whose compile signature was already registered "
+    "(warm: in-process jit cache or persistent-cache deserialize; "
+    "program label)",
+)
+compile_cache_misses = REGISTRY.counter(
+    "katib_compile_cache_misses_total",
+    "First steps whose compile signature was never seen before "
+    "(cold: full XLA compile on the critical path; program label)",
+)
+prewarm_compiles = REGISTRY.counter(
+    "katib_prewarm_compiles_total",
+    "Programs compiled ahead of execution by the background prewarm "
+    "worker / CLI prewarm verb (program label)",
+)
+first_step_compile_seconds = REGISTRY.histogram(
+    "katib_first_step_compile_seconds",
+    "Time from trial start to the first step boundary, split warm vs cold "
+    "(cache label) — a cache regression shows as the cold series growing",
+)
+
 # -- preemption / hang robustness (utils/watchdog.py, orchestrator drain) -----
 
 trial_hangs = REGISTRY.counter(
