@@ -16,7 +16,11 @@ observation store:
 - ``suggest-server``          suggestion-as-a-service daemon
 - ``db-manager``              native observation-log daemon (``--db`` = durable journal)
 - ``conformance``             packaged e2e invariants check (conformance/run.sh parity)
-- ``chaos``                   deterministic fault-injection run (fault-tolerance invariants)
+- ``chaos``                   deterministic fault-injection run (fault-tolerance invariants;
+                              ``--crash-at``/``--kill-at`` hard-kill a child at a
+                              registered persistence site and assert crash recovery)
+- ``fsck <workdir>/<exp>``    validate + repair an experiment dir (torn journal tail,
+                              snapshot checksums, suggester fence)
 - ``doctor``                  environment report (devices, native runtime)
 """
 
@@ -487,6 +491,253 @@ def cmd_conformance(args: argparse.Namespace) -> int:
     return 0
 
 
+#: the child script for the crashpoint scenarios: a tiny resumable sweep
+#: whose trainer exercises every registered persistence site (journal,
+#: status, suggester pickle, checkpoint manifest, store report, retry
+#: budget via one injected transient failure).  Run in a SUBPROCESS so the
+#: armed crash point can genuinely kill it; the parent resumes and asserts.
+_CRASH_CHILD_SCRIPT = """
+import os, sys
+sys.path[:0] = {syspath!r}
+import jax
+jax.config.update("jax_platforms", "cpu")
+from katib_tpu.core.types import (
+    AlgorithmSpec, ExperimentSpec, FeasibleSpace, ObjectiveSpec,
+    ObjectiveType, ParameterSpec, ParameterType, ResumePolicy,
+)
+from katib_tpu.orchestrator import Orchestrator
+from katib_tpu.utils.faults import FaultInjector
+from katib_tpu.suggest.base import register
+from katib_tpu.suggest.random_search import RandomSuggester
+
+# random search carries no state; this wrapper adds the resume hooks so
+# the suggester.pickle persistence site is actually exercised
+@register("chaos-random")
+class ChaosRandom(RandomSuggester):
+    def state_dict(self):
+        return {{"chaos": 1}}
+    def load_state_dict(self, data):
+        pass
+
+def trainer(ctx):
+    import jax.numpy as jnp
+    from katib_tpu.utils.checkpoint import TrialCheckpointer
+    os.makedirs(ctx.checkpoint_dir, exist_ok=True)
+    ck = TrialCheckpointer(ctx.checkpoint_dir, max_to_keep=1)
+    start = (ck.latest_step() or -1) + 1
+    x = float(ctx.params["lr"])
+    for step in range(start, 3):
+        ck.save({{"step": jnp.asarray(step)}}, step)
+        if not ctx.report(step=step, accuracy=(1.0 - (x - 0.05) ** 2) * (step + 1) / 3):
+            return
+
+injector = FaultInjector(seed=0)
+injector.fail_trial(0, 1)  # guarantees the retry.budget site is reached
+spec = ExperimentSpec(
+    name="chaos-crash",
+    algorithm=AlgorithmSpec(name="chaos-random", settings={{"seed": "0"}}),
+    objective=ObjectiveSpec(type=ObjectiveType.MAXIMIZE, objective_metric_name="accuracy"),
+    parameters=[ParameterSpec("lr", ParameterType.DOUBLE, FeasibleSpace(min=0.01, max=0.2))],
+    max_trial_count={trials}, parallel_trial_count=1, max_retries=2,
+    retry_backoff_seconds=0.01, resume_policy=ResumePolicy.LONG_RUNNING,
+    train_fn=trainer,
+)
+orch = Orchestrator(workdir={workdir!r}, fault_injector=injector)
+exp = orch.run(spec, resume=True)
+print("child finished:", exp.condition.value)
+"""
+
+
+def _chaos_crash(args: argparse.Namespace) -> int:
+    """The ``--crash-at`` / ``--kill-at`` scenario: arm one registered
+    CrashPoint in a child process (via ``KATIB_CRASH_AT``), let it die
+    mid-persistence, then resume IN-PROCESS from the journal and assert the
+    crash-consistency invariants — no settled trial lost, no duplicate
+    observation, retry budget monotone, optimal consistent.  Mirrors the
+    ``--preempt-at`` drain scenario, but with no drain at all: the child is
+    gone the instant the site fires."""
+    import sqlite3
+    import subprocess
+    import tempfile
+
+    from katib_tpu.core.types import (
+        AlgorithmSpec,
+        ExperimentSpec,
+        FeasibleSpace,
+        ObjectiveSpec,
+        ObjectiveType,
+        ParameterSpec,
+        ParameterType,
+        ResumePolicy,
+        TrialCondition,
+    )
+    from katib_tpu.orchestrator import Orchestrator, journal as jr
+    from katib_tpu.utils import faults
+
+    site_spec = args.crash_at or args.kill_at
+    site = site_spec.split(":", 1)[0]
+    if site not in faults.registered_crash_points():
+        print(
+            f"unknown crash point {site!r}; registered: "
+            f"{', '.join(faults.registered_crash_points())}",
+            file=sys.stderr,
+        )
+        return 2
+    mode = "kill" if args.kill_at else "exit"
+    failures: list[str] = []
+    with tempfile.TemporaryDirectory(prefix="katib-chaos-crash-") as workdir:
+        env = dict(os.environ)
+        env[faults.CRASH_AT_ENV] = site_spec
+        env[faults.CRASH_MODE_ENV] = mode
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        script = _CRASH_CHILD_SCRIPT.format(
+            syspath=[p for p in sys.path if p],
+            workdir=workdir,
+            trials=args.trials,
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", script],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=300,
+        )
+        died = proc.returncode not in (0,)
+        print(
+            f"chaos crash-at={site_spec} mode={mode}: child exited "
+            f"{proc.returncode}"
+        )
+        if not died:
+            failures.append(
+                f"crash point {site_spec!r} was never reached (child ran to "
+                "completion); scenario proves nothing"
+            )
+        else:
+            # what the journal PROVES happened before the kill
+            pre_state, pre_stats = jr.replay_journal(workdir, "chaos-crash")
+            pre_trials = (pre_state or {}).get("trials") or {}
+            settled_before = {
+                n: t
+                for n, t in pre_trials.items()
+                if TrialCondition(t.get("condition", "Created")).is_terminal()
+            }
+            # resume in this process — everything it knows comes from disk
+            def trainer(ctx):
+                import jax.numpy as jnp
+
+                from katib_tpu.utils.checkpoint import TrialCheckpointer
+
+                os.makedirs(ctx.checkpoint_dir, exist_ok=True)
+                ck = TrialCheckpointer(ctx.checkpoint_dir, max_to_keep=1)
+                start = (ck.latest_step() or -1) + 1
+                x = float(ctx.params["lr"])
+                for step in range(start, 3):
+                    ck.save({"step": jnp.asarray(step)}, step)
+                    if not ctx.report(
+                        step=step,
+                        accuracy=(1.0 - (x - 0.05) ** 2) * (step + 1) / 3,
+                    ):
+                        return
+
+            from katib_tpu.suggest.base import register
+            from katib_tpu.suggest.random_search import RandomSuggester
+
+            # same stateful wrapper the child registered (see
+            # _CRASH_CHILD_SCRIPT) — resume must resolve the algorithm name
+            @register("chaos-random")
+            class ChaosRandom(RandomSuggester):
+                def state_dict(self):
+                    return {"chaos": 1}
+
+                def load_state_dict(self, data):
+                    pass
+
+            spec = ExperimentSpec(
+                name="chaos-crash",
+                algorithm=AlgorithmSpec(name="chaos-random", settings={"seed": "0"}),
+                objective=ObjectiveSpec(
+                    type=ObjectiveType.MAXIMIZE, objective_metric_name="accuracy"
+                ),
+                parameters=[
+                    ParameterSpec(
+                        "lr", ParameterType.DOUBLE, FeasibleSpace(min=0.01, max=0.2)
+                    )
+                ],
+                max_trial_count=args.trials,
+                parallel_trial_count=1,
+                max_retries=2,
+                retry_backoff_seconds=0.01,
+                resume_policy=ResumePolicy.LONG_RUNNING,
+                train_fn=trainer,
+            )
+            orch = Orchestrator(workdir=workdir)
+            exp = orch.run(spec, resume=True)
+            print(
+                f"resumed: {exp.condition.value}, {len(exp.trials)} trial(s), "
+                f"{pre_stats.applied} journal record(s) replayed"
+            )
+            if not exp.condition.is_terminal():
+                failures.append(f"resumed experiment not terminal: {exp.condition.value}")
+            # invariant 1: no settled trial lost or demoted
+            for name, tdata in settled_before.items():
+                t = exp.trials.get(name)
+                if t is None:
+                    failures.append(f"settled trial lost across the crash: {name}")
+                elif t.condition.value != tdata["condition"]:
+                    failures.append(
+                        f"settled trial {name} changed condition across the "
+                        f"crash: {tdata['condition']} -> {t.condition.value}"
+                    )
+            # invariant 2: no duplicate observations in the durable store
+            db = os.path.join(workdir, "observations.sqlite")
+            if os.path.exists(db):
+                conn = sqlite3.connect(db)
+                dups = conn.execute(
+                    "SELECT trial_name, metric_name, step, COUNT(*) c FROM"
+                    " observation_logs WHERE step >= 0 GROUP BY trial_name,"
+                    " metric_name, step HAVING c > 1"
+                ).fetchall()
+                conn.close()
+                if dups:
+                    failures.append(f"duplicate observations in store: {dups[:5]}")
+            # invariant 3: retry budget monotone across the crash
+            for name, tdata in pre_trials.items():
+                t = exp.trials.get(name)
+                if t is not None and t.retry_count < int(tdata.get("retry_count") or 0):
+                    failures.append(
+                        f"retry budget reset across the crash for {name}: "
+                        f"{tdata.get('retry_count')} -> {t.retry_count}"
+                    )
+            # invariant 4: the optimal trial is consistent with its own record
+            if exp.optimal is not None:
+                best = exp.trials.get(exp.optimal.trial_name)
+                if best is None:
+                    failures.append(
+                        f"optimal trial {exp.optimal.trial_name} not in history"
+                    )
+                elif best.observation is None:
+                    failures.append(
+                        f"optimal trial {exp.optimal.trial_name} has no observation"
+                    )
+    if failures:
+        print("CHAOS FAIL: " + "; ".join(failures), file=sys.stderr)
+        return 1
+    print(f"CHAOS PASS: hard kill at {site_spec} recovered with invariants intact")
+    return 0
+
+
+def cmd_fsck(args: argparse.Namespace) -> int:
+    """Validate and repair an experiment directory (journal checksums,
+    torn tails, snapshot integrity, suggester fence) — see
+    ``orchestrator/fsck.py``.  Exit 0 when consistent after repairs."""
+    from katib_tpu.orchestrator.fsck import fsck_experiment
+
+    report = fsck_experiment(args.path, repair=not args.dry_run)
+    for line in report.lines():
+        print(line)
+    return 0 if report.ok() else 1
+
+
 def cmd_chaos(args: argparse.Namespace) -> int:
     """Deterministic fault-injection run: a seeded ``FaultInjector`` plants
     transient trial failures and suggester exceptions in a small white-box
@@ -494,6 +745,11 @@ def cmd_chaos(args: argparse.Namespace) -> int:
     (transient retries recover with checkpoint resume, permanent failures
     don't retry, the suggester circuit breaker absorbs sub-threshold errors).
     The chaos analog of ``conformance``: same experiment, hostile weather."""
+    if getattr(args, "crash_at", None) or getattr(args, "kill_at", None):
+        if args.crash_at and args.kill_at:
+            print("--crash-at and --kill-at are mutually exclusive", file=sys.stderr)
+            return 2
+        return _chaos_crash(args)
     import tempfile
 
     from katib_tpu.core.types import (
@@ -1252,7 +1508,41 @@ def main(argv: list[str] | None = None) -> int:
         default=5.0,
         help="drainGraceSeconds for the chaos experiment",
     )
+    p.add_argument(
+        "--crash-at",
+        metavar="SITE[:N]",
+        default=None,
+        help="hard-crash (os._exit, no drain, no cleanup) a child sweep at "
+        "the N-th (default 1st) hit of a registered persistence crash "
+        "point, then resume in-process and assert no settled trial is "
+        "lost, no observation duplicated, and the retry budget is "
+        "monotone; sites: journal.append, journal.snapshot, "
+        "suggester.pickle, status.write, checkpoint.manifest, "
+        "retry.budget, store.report",
+    )
+    p.add_argument(
+        "--kill-at",
+        metavar="SITE[:N]",
+        default=None,
+        help="like --crash-at but the child dies by SIGKILL "
+        "(indistinguishable from the OOM killer)",
+    )
     p.set_defaults(fn=cmd_chaos)
+
+    p = sub.add_parser(
+        "fsck",
+        help="validate and repair an experiment dir (journal, snapshots, fence)",
+    )
+    p.add_argument(
+        "path",
+        help="experiment directory to check, e.g. <workdir>/<experiment>",
+    )
+    p.add_argument(
+        "--dry-run",
+        action="store_true",
+        help="report damage without repairing (nonzero exit if any found)",
+    )
+    p.set_defaults(fn=cmd_fsck)
 
     p = sub.add_parser(
         "db-manager", help="run the native observation-log daemon"

@@ -166,6 +166,10 @@ class ShapeRegistry:
         self._lock = threading.Lock()
         self._seen: dict[str, dict] = {}
         self._loaded_dir: str | None = None
+        # byte length of the valid prefix when the registry file ends in a
+        # torn/corrupt line (crash mid-append); the next _append truncates
+        # to here first so the file heals instead of growing garbage
+        self._truncate_to: int | None = None
 
     # -- persistence (best-effort) ----------------------------------------
 
@@ -180,17 +184,42 @@ class ShapeRegistry:
         if d is None or d == self._loaded_dir:
             return
         self._loaded_dir = d
+        self._truncate_to = None
         path = os.path.join(d, _REGISTRY_FILENAME)
         try:
-            with open(path) as f:
-                for line in f:
+            with open(path, "rb") as f:
+                offset = 0
+                valid_end = 0
+                torn = 0
+                for raw in f:
+                    offset += len(raw)
+                    line = raw.decode("utf-8", errors="replace").strip()
+                    if not line:
+                        continue
                     try:
                         rec = json.loads(line)
                     except json.JSONDecodeError:
+                        # same torn-tail rule as the experiment journal:
+                        # tolerate the bad line, remember where the valid
+                        # prefix ends so the next append truncates it away
+                        torn += 1
                         continue
-                    key = rec.get("key")
+                    torn = 0
+                    valid_end = offset
+                    key = rec.get("key") if isinstance(rec, dict) else None
                     if key:
                         self._seen.setdefault(key, rec)
+                if torn:
+                    import warnings
+
+                    warnings.warn(
+                        f"shape registry {path} ends in {torn} torn/corrupt "
+                        f"line(s) ({offset - valid_end} bytes) — skipped; "
+                        "will truncate on next append",
+                        RuntimeWarning,
+                        stacklevel=2,
+                    )
+                    self._truncate_to = valid_end
         except OSError:
             pass
 
@@ -199,6 +228,13 @@ class ShapeRegistry:
         if path is None:
             return
         try:
+            if self._truncate_to is not None:
+                # heal the torn tail _maybe_load found before appending
+                # after it (appending after garbage would orphan every
+                # later record for pre-fix readers)
+                with open(path, "rb+") as f:
+                    f.truncate(self._truncate_to)
+                self._truncate_to = None
             with open(path, "a") as f:
                 f.write(json.dumps(rec) + "\n")
         except OSError:

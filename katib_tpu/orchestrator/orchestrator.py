@@ -109,6 +109,9 @@ class Orchestrator:
         self._profile_lock = threading.Lock()
         # per-run background compile prewarmer (katib_tpu/compile/prewarm.py)
         self._prewarm = None
+        # per-run crash-consistent event journal (orchestrator/journal.py);
+        # opened by run(), closed in its finally
+        self._journal = None
         # external stop request (client delete / shutdown): sticky so a stop
         # issued before run() enters its loop is not lost; each run() has its
         # own wind-down event for in-flight trials
@@ -202,20 +205,32 @@ class Orchestrator:
 
         suggester = make_suggester(spec)
         # restore durable suggester state (ENAS controller pytree, PBT job
-        # queue) — the FromVolume PVC analog.  Never-policy experiments keep
-        # no state on disk, matching the reference tearing the service down
-        # with nothing to resurrect from.
+        # queue) — the FromVolume PVC analog, FENCED against the experiment
+        # journal: a pickle written before settlements the journal proves
+        # (hard kill between a settle and the next persist) is stale and is
+        # discarded — the replay-derived fresh suggester rebuilds from trial
+        # history instead of trusting it blindly.  Never-policy experiments
+        # keep no state on disk, matching the reference tearing the service
+        # down with nothing to resurrect from.
         if experiment is not None and spec.resume_policy is not ResumePolicy.NEVER:
+            from katib_tpu.orchestrator import journal as _journal_mod
             from katib_tpu.orchestrator.resume import load_suggester_state
 
-            load_suggester_state(suggester, self.workdir, exp.name)
-        # Lossless resume: resumable experiments upgrade a defaulted
-        # in-memory store to the durable sqlite backend, so early stopping
-        # reads TRUE per-trial series across restarts instead of
-        # _backfill_store's one-point approximation (the reference's
-        # observations live in the DB-manager's SQL table and survive
-        # controller restarts for free — ``mysql/init.go:35``).
-        if self._store_defaulted and spec.resume_policy is not ResumePolicy.NEVER:
+            load_suggester_state(
+                suggester,
+                self.workdir,
+                exp.name,
+                settled_fence=_journal_mod.last_settled_seq(self.workdir, exp.name),
+            )
+        # Durable-by-default observations: a defaulted in-memory store is
+        # upgraded to the sqlite WAL backend for EVERY run, so a hard kill
+        # never loses reported series (the reference's observations live in
+        # the DB-manager's SQL table and survive controller restarts for
+        # free — ``mysql/init.go:35``) and early stopping reads TRUE
+        # per-trial series across restarts instead of _backfill_store's
+        # one-point approximation.  An explicitly passed store is never
+        # touched.
+        if self._store_defaulted:
             from katib_tpu.store.sqlite import SqliteObservationStore
 
             os.makedirs(self.workdir, exist_ok=True)
@@ -223,6 +238,16 @@ class Orchestrator:
                 os.path.join(self.workdir, "observations.sqlite")
             )
             self._store_defaulted = False  # keep it for later runs too
+        # crash-consistent event journal (orchestrator/journal.py): the
+        # durable source of truth for resume; status.json stays the derived
+        # CLI/UI view.  Best-effort open — an unwritable workdir degrades to
+        # the pre-journal behavior rather than failing the experiment.
+        try:
+            from katib_tpu.orchestrator.journal import ExperimentJournal
+
+            self._journal = ExperimentJournal(self.workdir, exp.name)
+        except OSError:
+            self._journal = None
         if experiment is not None:
             self._backfill_store(exp)
         early_stopper = make_early_stopper(spec)
@@ -230,6 +255,11 @@ class Orchestrator:
             early_stopper.bind_store(self.store)
 
         exp.condition = ExperimentCondition.RUNNING
+        self._jappend(
+            "experiment",
+            exp,
+            extra={"name": exp.name, "algorithm": spec.algorithm.name},
+        )
         obs.experiments_created.inc(algorithm=spec.algorithm.name)
         obs.experiments_current.inc()
         # open the span journal (append-mode: a resumed experiment continues
@@ -328,6 +358,7 @@ class Orchestrator:
                         self._suggester_owned_ckpts.add(trial.name)
                     trial.condition = TrialCondition.RUNNING
                     trial.start_time = time.time()
+                    self._jappend("started", exp, trial=trial)
                     futures[pool.submit(self._execute, exp, trial, mesh)] = trial
             while True:
                 self._harvest(exp, futures)
@@ -497,8 +528,66 @@ class Orchestrator:
                     closer(exp)
                 except Exception:
                     pass
+            journal, self._journal = self._journal, None
+            if journal is not None:
+                journal.close()
 
     # -- internals ----------------------------------------------------------
+
+    def _journal_exp_state(self, exp: Experiment) -> dict:
+        """The experiment-level slice every journal record carries so replay
+        is state-identical to a status.json resume (trial dicts ride
+        separately per record)."""
+        return {
+            "condition": exp.condition.value,
+            "message": exp.message,
+            "start_time": exp.start_time,
+            "completion_time": exp.completion_time,
+            "algorithm_settings": dict(exp.algorithm_settings),
+            "optimal": (
+                None
+                if exp.optimal is None
+                else {
+                    "trial_name": exp.optimal.trial_name,
+                    "objective_value": exp.optimal.objective_value,
+                    "assignments": {
+                        a.name: a.value for a in exp.optimal.assignments
+                    },
+                }
+            ),
+            "optimal_history": list(exp.optimal_history),
+        }
+
+    def _jappend(
+        self,
+        event: str,
+        exp: Experiment,
+        trial: Trial | None = None,
+        extra: dict | None = None,
+    ) -> None:
+        """Durably journal one state transition; best-effort like _publish —
+        a full disk must degrade resume fidelity, not kill the run loop.
+        Thread-safe (the journal locks internally): retry records arrive
+        from trial pool threads."""
+        j = self._journal
+        if j is None:
+            return
+        try:
+            from katib_tpu.orchestrator.status import trial_to_dict
+
+            data: dict = {"exp": self._journal_exp_state(exp)}
+            if trial is not None:
+                data["trial"] = trial_to_dict(trial)
+            if extra:
+                data.update(extra)
+            j.append(
+                event,
+                trial=trial.name if trial is not None else None,
+                epoch=trial.retry_count if trial is not None else 0,
+                data=data,
+            )
+        except (OSError, ValueError):
+            pass
 
     def _materialize(self, exp: Experiment, proposal, early_stopper, suggester) -> Trial:
         name = proposal.name or f"{exp.name}-{secrets.token_hex(4)}"
@@ -534,6 +623,7 @@ class Orchestrator:
             checkpoint_dir=ckpt,
         )
         exp.trials[name] = trial
+        self._jappend("proposed", exp, trial=trial)
         obs.trials_created.inc()
         return trial
 
@@ -722,6 +812,10 @@ class Orchestrator:
                     t.retry_count += 1
                     t.failure_kind = r.failure_kind.value
                     obs.trials_retried.inc(kind=r.failure_kind.value)
+                    # kill window: budget spent in memory, not yet durable —
+                    # the journal record below is what makes it crash-proof
+                    faults.crash_point("retry.budget")
+                    self._jappend("retried", exp, trial=t)
                     self._publish(exp)
                     results[t.name] = self._execute(exp, t, mesh)
                 elif (
@@ -824,7 +918,10 @@ class Orchestrator:
             trial.failure_kind = result.failure_kind.value
             obs.trials_retried.inc(kind=result.failure_kind.value)
             # journal the spent retry before sleeping: a crash mid-backoff
-            # must not reset the per-trial retry budget on resume
+            # must not reset the per-trial retry budget on resume.  The
+            # crash point covers the window where the bump is memory-only.
+            faults.crash_point("retry.budget")
+            self._jappend("retried", exp, trial=trial)
             self._publish(exp)
             if not backoff.wait(trial.retry_count, self._stop_event):
                 break
@@ -902,6 +999,16 @@ class Orchestrator:
             )
             tracing.deactivate(self._prev_tracer)
             tracer.close()
+        # terminal record + final snapshot: a later resume replays one
+        # snapshot instead of the whole event log
+        self._jappend("experiment", exp)
+        if self._journal is not None:
+            try:
+                from katib_tpu.orchestrator.status import experiment_to_dict
+
+                self._journal.snapshot(experiment_to_dict(exp))
+            except (OSError, ValueError):
+                pass
         self._publish(exp)
 
     def _drain_and_exit(
@@ -952,6 +1059,7 @@ class Orchestrator:
                     f"drain_grace_seconds={grace:g}; resuming from last checkpoint"
                 )
                 stragglers.append(trial)
+                self._jappend("drained", exp, trial=trial)
         stop_event.set()
         exp.update_optimal()
         self._persist_suggester(exp, suggester)
@@ -961,6 +1069,7 @@ class Orchestrator:
             "resumable with --resume"
         )
         self.drained = True
+        self._jappend("experiment", exp)
         duration = time.perf_counter() - t0
         obs.experiments_current.dec()
         tracer, self._tracer = self._tracer, None
@@ -1034,7 +1143,12 @@ class Orchestrator:
         try:
             from katib_tpu.orchestrator.resume import save_suggester_state
 
-            save_suggester_state(suggester, self.workdir, exp.name)
+            save_suggester_state(
+                suggester,
+                self.workdir,
+                exp.name,
+                fence=self._journal.seq if self._journal is not None else None,
+            )
         except Exception:
             # best-effort like the status journal: an unpicklable custom
             # state_dict (TypeError, not just PicklingError) must never mask
@@ -1073,10 +1187,12 @@ class Orchestrator:
                         # submits it fresh (no budget slot consumed)
                         trial.condition = TrialCondition.PENDING
                         trial.message = "drained before start; resubmitted on resume"
+                        self._jappend("drained", exp, trial=trial)
                         continue
                     trial.condition = TrialCondition.KILLED
                     trial.completion_time = time.time()
                     obs.trials_killed.inc()
+                    self._jappend("settled", exp, trial=trial)
                     self._observe_trial_duration(trial)
                 continue
             result = f.result()  # _execute / _execute_cohort never raise
@@ -1117,7 +1233,40 @@ class Orchestrator:
                 self._observe_trial_duration(trial)
                 self._cleanup_trial(trial)
             exp.update_optimal()
+            # durably journal each member's outcome: terminal conditions are
+            # exactly-once settlements keyed by (trial, attempt epoch);
+            # Drained stays non-terminal (resubmitted on resume).  The
+            # "reported" record carries the reduced observation separately
+            # so replay can restore metrics for trials the settle record of
+            # which is ever lost to a torn tail.
+            for trial in members:
+                if trial.condition is TrialCondition.DRAINED:
+                    self._jappend("drained", exp, trial=trial)
+                else:
+                    if trial.observation is not None:
+                        from katib_tpu.orchestrator.status import (
+                            _observation_to_dict,
+                        )
+
+                        self._jappend(
+                            "reported",
+                            exp,
+                            trial=trial,
+                            extra={
+                                "observation": _observation_to_dict(
+                                    trial.observation
+                                )
+                            },
+                        )
+                    self._jappend("settled", exp, trial=trial)
         if done:
+            if self._journal is not None:
+                try:
+                    from katib_tpu.orchestrator.status import experiment_to_dict
+
+                    self._journal.maybe_compact(lambda: experiment_to_dict(exp))
+                except (OSError, ValueError):
+                    pass
             self._publish(exp)
 
     def _cleanup_trial(self, trial: Trial) -> None:

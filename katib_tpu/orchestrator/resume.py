@@ -29,7 +29,6 @@ from __future__ import annotations
 import math
 import os
 import pickle
-import tempfile
 
 from katib_tpu.core.types import (
     Experiment,
@@ -176,10 +175,28 @@ def experiment_from_dict(spec: ExperimentSpec, status: dict) -> Experiment:
 
 
 def load_experiment(spec: ExperimentSpec, workdir: str) -> Experiment | None:
-    """Read ``<workdir>/<spec.name>/status.json`` back into an Experiment;
-    None when no journal exists (fresh run)."""
-    from katib_tpu.orchestrator.status import read_status
+    """Rebuild an Experiment from its durable state; None when none exists
+    (fresh run).
 
+    The crash-consistent event journal (``orchestrator/journal.py``) is the
+    source of truth when present: replay applies snapshot + suffix with
+    exactly-once settlement, so a hard kill mid-publish can neither lose a
+    settled trial nor settle one twice.  ``status.json`` remains the
+    fallback for pre-journal experiment dirs (and stays the view the
+    CLI/UI read)."""
+    from katib_tpu.orchestrator import journal as jr
+    from katib_tpu.orchestrator.status import read_status
+    from katib_tpu.utils import observability as obs
+
+    if os.path.exists(jr.journal_path(workdir, spec.name)) or jr.list_snapshots(
+        os.path.join(workdir, spec.name)
+    ):
+        status, stats = jr.replay_journal(workdir, spec.name)
+        if status is not None:
+            obs.journal_replayed_events.inc(stats.applied)
+            if stats.duplicates:
+                obs.settlement_duplicates.inc(stats.duplicates)
+            return experiment_from_dict(spec, status)
     status = read_status(workdir, spec.name)
     if status is None:
         return None
@@ -193,30 +210,65 @@ def suggester_state_path(workdir: str, experiment_name: str) -> str:
     return os.path.join(workdir, experiment_name, SUGGESTER_STATE_FILE)
 
 
-def save_suggester_state(suggester, workdir: str, experiment_name: str) -> bool:
-    """Pickle ``suggester.state_dict()`` atomically; no-op (False) for
-    replay-derived suggesters that expose no state hook."""
+#: wrapper marker for fenced pickles; bare (legacy) pickles still load
+_FENCE_MARKER = "__katib_suggester_state__"
+
+
+def save_suggester_state(
+    suggester, workdir: str, experiment_name: str, fence: int | None = None
+) -> bool:
+    """Durably pickle ``suggester.state_dict()``; no-op (False) for
+    replay-derived suggesters that expose no state hook.
+
+    ``fence`` is the experiment journal's sequence number at persist time.
+    It rides inside the pickle so a resume can tell whether the state is
+    CURRENT (fence ≥ the journal's last settled seq) or STALE — written
+    before settlements the journal proves happened, e.g. a hard kill
+    between a trial settling and the next suggester persist.  Stale state
+    is discarded and the suggester rebuilds from replayed trial history
+    instead of being trusted blindly."""
+    from katib_tpu.utils.fsio import atomic_replace
+
     state_fn = getattr(suggester, "state_dict", None)
     if state_fn is None:
         return False
     exp_dir = os.path.join(workdir, experiment_name)
     os.makedirs(exp_dir, exist_ok=True)
     path = suggester_state_path(workdir, experiment_name)
-    fd, tmp = tempfile.mkstemp(dir=exp_dir, prefix=".sugg-", suffix=".tmp")
-    try:
-        with os.fdopen(fd, "wb") as f:
-            pickle.dump(state_fn(), f)
-        os.replace(tmp, path)
-    except BaseException:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-        raise
+    payload = pickle.dumps({_FENCE_MARKER: 1, "fence": fence, "state": state_fn()})
+    atomic_replace(path, payload, prefix=".sugg-", crash_site="suggester.pickle")
     return True
 
 
-def load_suggester_state(suggester, workdir: str, experiment_name: str) -> bool:
+def read_suggester_fence(workdir: str, experiment_name: str) -> int | None:
+    """The fence recorded in the pickled suggester state; None when the
+    file is absent/legacy/unreadable.  Used by ``katib-tpu fsck`` to report
+    fence mismatches without mutating anything."""
+    path = suggester_state_path(workdir, experiment_name)
+    try:
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+    except Exception:
+        return None
+    if isinstance(state, dict) and state.get(_FENCE_MARKER):
+        fence = state.get("fence")
+        return int(fence) if fence is not None else None
+    return None
+
+
+def load_suggester_state(
+    suggester,
+    workdir: str,
+    experiment_name: str,
+    settled_fence: int | None = None,
+) -> bool:
     """Restore a previously pickled state into the suggester; False when the
-    file or the hook is absent."""
+    file or the hook is absent — or when the state is FENCED OUT:
+    ``settled_fence`` (the journal's last settled seq) newer than the
+    pickle's recorded fence means the state predates settlements the
+    journal proves, so it is discarded and the caller's replay-derived
+    fresh suggester stands (counted in
+    ``katib_suggester_fence_rebuilds_total``)."""
     load_fn = getattr(suggester, "load_state_dict", None)
     if load_fn is None:
         return False
@@ -224,6 +276,32 @@ def load_suggester_state(suggester, workdir: str, experiment_name: str) -> bool:
     try:
         with open(path, "rb") as f:
             state = pickle.load(f)
+        fenced = isinstance(state, dict) and state.get(_FENCE_MARKER)
+        fence = state.get("fence") if fenced else None
+        # a journal that proves settlements fences out any pickle that
+        # cannot prove it saw them — including legacy bare pickles, which
+        # record no fence at all.  Journal-less dirs (settled_fence 0) keep
+        # loading legacy pickles unconditionally.
+        if (
+            settled_fence is not None
+            and settled_fence > 0
+            and (fence is None or int(fence) < settled_fence)
+        ):
+            import logging
+
+            from katib_tpu.utils import observability as obs
+
+            obs.suggester_fence_rebuilds.inc()
+            logging.getLogger(__name__).warning(
+                "suggester state at %s is stale (fence=%s < journal settled "
+                "seq %d); rebuilding from replayed trial history",
+                path,
+                fence,
+                settled_fence,
+            )
+            return False
+        if fenced:
+            state = state["state"]
         load_fn(state)
     except Exception:
         # a truncated/corrupt pickle (crash between replace and flush) or a

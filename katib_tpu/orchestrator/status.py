@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import json
 import os
-import tempfile
 
 from katib_tpu.core.types import Experiment, Observation, Trial
 
@@ -101,19 +100,21 @@ def _device_health() -> dict | None:
 
 
 def write_status(exp: Experiment, workdir: str) -> str:
-    """Atomically write the experiment's status file; returns its path."""
+    """Atomically AND durably write the experiment's status file; returns
+    its path.  The temp file is fsync'd before the rename and the directory
+    after it (utils/fsio.py) — rename-only atomicity still loses the data
+    blocks on some filesystems when a hard kill lands right after the
+    replace, which is exactly the window ``chaos --crash-at status.write``
+    exercises."""
+    from katib_tpu.utils.fsio import atomic_replace
+
     exp_dir = os.path.join(workdir, exp.name)
     os.makedirs(exp_dir, exist_ok=True)
     path = os.path.join(exp_dir, STATUS_FILE)
-    fd, tmp = tempfile.mkstemp(dir=exp_dir, prefix=".status-", suffix=".tmp")
-    try:
-        with os.fdopen(fd, "w") as f:
-            json.dump(experiment_to_dict(exp), f, indent=1, default=str)
-        os.replace(tmp, path)
-    except BaseException:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-        raise
+    payload = json.dumps(experiment_to_dict(exp), indent=1, default=str)
+    atomic_replace(
+        path, payload.encode(), prefix=".status-", crash_site="status.write"
+    )
     return path
 
 

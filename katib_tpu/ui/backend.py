@@ -217,6 +217,17 @@ class UiServer:
                 return 409, {"error": f"experiment {spec.name!r} is already running"}
             if read_status(self.workdir, spec.name) is not None:
                 return 409, {"error": f"experiment {spec.name!r} already exists"}
+            # journal the Created state BEFORE 201 so the resource exists the
+            # moment the client learns its name — the background run's own
+            # first publish lands after its durable-store + event-journal
+            # setup, a window where GET /api/experiment/<name> would 404
+            try:
+                from katib_tpu.core.types import Experiment
+                from katib_tpu.orchestrator.status import write_status
+
+                write_status(Experiment(spec=spec), self.workdir)
+            except OSError:
+                pass  # the run thread's publish will catch up
             orch = Orchestrator(workdir=self.workdir, store=self.store)
             thread = threading.Thread(
                 target=self._run_background,
@@ -635,6 +646,10 @@ async function counters(){
     ((tot('katib_compile_cache_hits_total')||tot('katib_compile_cache_misses_total'))?
       ` · compile cache: ${tot('katib_compile_cache_hits_total')} warm / ${tot('katib_compile_cache_misses_total')} cold`:'')+
     (tot('katib_prewarm_compiles_total')?` · prewarmed: ${tot('katib_prewarm_compiles_total')}`:'')+
+    (tot('katib_journal_replayed_events_total')?` · journal replayed: ${tot('katib_journal_replayed_events_total')}`:'')+
+    (tot('katib_settlement_duplicates_total')?` · settle dups dropped: ${tot('katib_settlement_duplicates_total')}`:'')+
+    (tot('katib_suggester_fence_rebuilds_total')?` · fence rebuilds: ${tot('katib_suggester_fence_rebuilds_total')}`:'')+
+    (tot('katib_fsck_repairs_total')?` · fsck repairs: ${tot('katib_fsck_repairs_total')}`:'')+
     (spd!==null?` · steps/dispatch: ${spd.toFixed(1)}${spd<=1?' <b>EAGER</b>':''}`:'')+
     (mean!==null?` · mean trial ${mean.toFixed(1)}s`:'')+'</small>';
 }

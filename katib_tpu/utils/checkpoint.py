@@ -36,7 +36,6 @@ import json
 import os
 import re
 import shutil
-import tempfile
 from typing import Any
 
 _STEP_DIR = re.compile(r"^step_(\d{8})$")
@@ -193,23 +192,23 @@ class TrialCheckpointer:
 
     def _write_manifest(self, pytree: Any, step: int, step_dir: str) -> None:
         # best-effort (a manifest-less step still restores, just unverified);
-        # written atomically so a preemption mid-write can't leave a manifest
-        # that condemns a perfectly good step
+        # written atomically AND durably (fsync file + dir, utils/fsio.py) so
+        # neither a preemption mid-write nor a hard kill right after the
+        # rename can leave a manifest that condemns a perfectly good step
         try:
+            from katib_tpu.utils.fsio import atomic_replace
+
             doc = {
                 "step": step,
                 "tree_digest": _tree_digest(pytree),
                 "files": _walk_sizes(step_dir),
             }
-            fd, tmp = tempfile.mkstemp(dir=self.directory, prefix=".manifest-")
-            try:
-                with os.fdopen(fd, "w") as f:
-                    json.dump(doc, f)
-                os.replace(tmp, _manifest_path(self.directory, step))
-            except BaseException:
-                if os.path.exists(tmp):
-                    os.unlink(tmp)
-                raise
+            atomic_replace(
+                _manifest_path(self.directory, step),
+                json.dumps(doc).encode(),
+                prefix=".manifest-",
+                crash_site="checkpoint.manifest",
+            )
         except Exception:
             pass
 

@@ -604,3 +604,74 @@ class FaultInjector:
                         f.write(b"\x00CORRUPTED-BY-FAULT-INJECTOR")
                 except OSError:
                     pass
+
+
+# ---------------------------------------------------------------------------
+# Deterministic crash points (the hard-kill sibling of FaultInjector)
+# ---------------------------------------------------------------------------
+#
+# FaultInjector raises exceptions INSIDE a live process — it exercises the
+# retry/classify paths but can never prove crash consistency, because the
+# process survives to run its cleanup handlers.  A CrashPoint is the real
+# thing: `crash_point("journal.append")` dies instantly (`os._exit` or
+# SIGKILL, no atexit, no finally, no flush) when armed, so the bytes on disk
+# at that instant are exactly what a power loss there would leave.  Each
+# persistence site in the tree calls `crash_point(<site>)` in its
+# vulnerable window; `katib-tpu chaos --crash-at/--kill-at <site>[:<n>]`
+# and the sweep test in tests/test_journal_crash.py arm them via the
+# environment (inherited by subprocesses, which is the point: the parent
+# arms, the child dies, the parent resumes and asserts invariants).
+
+#: env var arming one site: "site" or "site:n" (die on the n-th hit, 1-based)
+CRASH_AT_ENV = "KATIB_CRASH_AT"
+#: env var selecting how to die: "exit" (os._exit 137, default) or "kill"
+#: (SIGKILL to self — indistinguishable from the OOM killer)
+CRASH_MODE_ENV = "KATIB_CRASH_MODE"
+
+#: every registered persistence site, in journal order.  Static so the
+#: sweep test and the chaos CLI can enumerate sites without importing (and
+#: therefore executing) every module that hosts one.
+CRASH_POINTS = (
+    "journal.append",      # journal record written, not yet fsync'd
+    "journal.snapshot",    # snapshot temp file written, not yet renamed
+    "suggester.pickle",    # suggester state temp file written, not renamed
+    "status.write",        # status.json temp file written, not renamed
+    "checkpoint.manifest", # checkpoint manifest temp written, not renamed
+    "retry.budget",        # retry_count bumped in memory, not yet journaled
+    "store.report",        # observation rows inserted, not yet committed
+)
+
+_crash_hits: dict[str, int] = {}
+_crash_lock = threading.Lock()
+
+
+def registered_crash_points() -> tuple[str, ...]:
+    return CRASH_POINTS
+
+
+def crash_point(site: str) -> None:
+    """Die instantly iff ``KATIB_CRASH_AT`` arms ``site`` and this is the
+    armed hit.  Unarmed (the normal case) this is one env read — cheap
+    enough to leave in production code paths."""
+    spec = os.environ.get(CRASH_AT_ENV)
+    if not spec:
+        return
+    armed, _, nth = spec.partition(":")
+    if armed != site:
+        return
+    try:
+        want = max(1, int(nth)) if nth else 1
+    except ValueError:
+        want = 1
+    with _crash_lock:
+        _crash_hits[site] = _crash_hits.get(site, 0) + 1
+        hit = _crash_hits[site]
+    if hit < want:
+        return
+    if os.environ.get(CRASH_MODE_ENV) == "kill":
+        import signal
+
+        os.kill(os.getpid(), signal.SIGKILL)
+        # SIGKILL delivery can race the return; never fall through alive
+        time.sleep(60)
+    os._exit(137)

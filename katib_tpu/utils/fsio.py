@@ -1,0 +1,67 @@
+"""Durable filesystem writes — the one place that knows how to make a
+file survive a hard kill (SIGKILL/OOM/power loss, not just SIGTERM).
+
+``os.replace`` alone gives atomicity (readers see old or new, never a
+mix) but NOT durability: on many filesystems the rename can hit disk
+before the data blocks, so a crash right after replace surfaces an
+empty or partial file.  The full recipe is fsync(tempfile) →
+``os.replace`` → fsync(directory), and every persistence site in the
+tree (status snapshot, suggester pickle, journal snapshot, checkpoint
+manifest) routes through here so none of them can drift on the recipe.
+
+Stdlib-only, jax-free.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a directory so a just-renamed entry is durable.  Best-effort:
+    some platforms/filesystems refuse O_RDONLY on directories."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_replace(
+    path: str,
+    data: bytes,
+    *,
+    prefix: str = ".tmp-",
+    crash_site: str | None = None,
+) -> None:
+    """Durably replace ``path`` with ``data``: write a sibling temp file,
+    flush + fsync it, rename over ``path``, fsync the directory.
+
+    ``crash_site`` names the :func:`katib_tpu.utils.faults.crash_point`
+    fired between the temp-file write and the rename — the window the
+    deterministic crash harness kills in to prove readers only ever see
+    the old complete file or the new complete file.
+    """
+    d = os.path.dirname(path) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=prefix)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        if crash_site is not None:
+            from katib_tpu.utils.faults import crash_point
+
+            crash_point(crash_site)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    fsync_dir(d)

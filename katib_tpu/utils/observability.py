@@ -508,6 +508,26 @@ compile_hangs = REGISTRY.counter(
     "Trials whose jit compile / first dispatch overran "
     "compileDeadlineSeconds (classified retryable CompileHang)",
 )
+journal_replayed_events = REGISTRY.counter(
+    "katib_journal_replayed_events_total",
+    "Experiment-journal records applied during a resume replay "
+    "(orchestrator/journal.py)",
+)
+settlement_duplicates = REGISTRY.counter(
+    "katib_settlement_duplicates_total",
+    "Duplicate/out-of-order settled records dropped by exactly-once "
+    "replay (keyed by trial name + attempt epoch)",
+)
+suggester_fence_rebuilds = REGISTRY.counter(
+    "katib_suggester_fence_rebuilds_total",
+    "Stale suggester_state.pkl discarded on resume (fence older than the "
+    "journal's last settled seq); suggester rebuilt from trial history",
+)
+fsck_repairs = REGISTRY.counter(
+    "katib_fsck_repairs_total",
+    "Repairs applied by katib-tpu fsck (torn journal tails truncated, "
+    "unverifiable snapshots quarantined)",
+)
 
 
 def record_device_memory(registry_gauge: _Metric | None = None) -> None:

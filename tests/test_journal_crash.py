@@ -1,0 +1,450 @@
+"""Crash-consistency: the event journal, CrashPoints, fences, and fsck.
+
+Every durable artifact in the orchestrator (journal, snapshots, suggester
+pickle, status.json, checkpoint manifest, sqlite store) has a registered
+CrashPoint at its most vulnerable instant — bytes written but not yet
+durable.  These tests kill real child processes at each site and prove the
+recovery contract:
+
+- crashpoint sweep: for EVERY registered site, a hard death mid-persistence
+  resumes with no settled trial lost, no duplicate observation, and a
+  monotone retry budget (via the same harness ``katib-tpu chaos --crash-at``
+  ships);
+- a torn journal tail (crash mid-append) is skipped on replay and truncated
+  on the next open;
+- replay from a compaction snapshot is state-identical to replaying the
+  full log;
+- the sqlite store in WAL mode never surfaces a half-committed report after
+  ``os._exit`` mid-transaction;
+- a suggester pickle fenced behind the journal's settled seq is rejected
+  and rebuilt from history instead of silently losing observations;
+- ``fsck`` detects and repairs the torn tail / quarantines bad snapshots.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from katib_tpu.core.types import MetricLog
+from katib_tpu.orchestrator import journal as jr
+from katib_tpu.utils import faults
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class _StatefulSuggester:
+    """Minimal suggester exposing the resume state hooks."""
+
+    def __init__(self):
+        self.loaded = None
+
+    def state_dict(self):
+        return {"portfolio": [1, 2, 3]}
+
+    def load_state_dict(self, data):
+        self.loaded = data
+
+
+def _mini_journal(tmp_path, name="crash-exp", snapshot_every=1000):
+    j = jr.ExperimentJournal(str(tmp_path), name, snapshot_every=snapshot_every)
+    return j
+
+
+def _trial(condition="Running", retry_count=0, observation=None):
+    return {
+        "condition": condition,
+        "retry_count": retry_count,
+        "observation": observation,
+        "assignments": {"lr": 0.1},
+    }
+
+
+class TestCrashPointSweep:
+    """Hard-kill a child orchestrator at every registered persistence site,
+    then resume from the journal and assert the invariants.  This drives the
+    exact harness ``katib-tpu chaos --crash-at`` exposes, so the CLI verb is
+    covered too."""
+
+    @pytest.mark.parametrize("site", faults.registered_crash_points())
+    def test_crash_then_resume(self, site):
+        from katib_tpu import cli
+
+        args = argparse.Namespace(crash_at=site, kill_at=None, trials=3)
+        assert cli._chaos_crash(args) == 0, f"crash sweep failed at {site!r}"
+
+    def test_sigkill_mode(self):
+        """--kill-at: death by SIGKILL (OOM-killer shaped) instead of
+        os._exit — same recovery contract."""
+        from katib_tpu import cli
+
+        args = argparse.Namespace(crash_at=None, kill_at="journal.append", trials=3)
+        assert cli._chaos_crash(args) == 0
+
+    def test_unknown_site_rejected(self):
+        from katib_tpu import cli
+
+        args = argparse.Namespace(crash_at="no.such.site", kill_at=None, trials=3)
+        assert cli._chaos_crash(args) == 2
+
+    def test_registry_is_complete(self):
+        assert set(faults.registered_crash_points()) == {
+            "journal.append",
+            "journal.snapshot",
+            "suggester.pickle",
+            "status.write",
+            "checkpoint.manifest",
+            "retry.budget",
+            "store.report",
+        }
+
+
+class TestTornTail:
+    def test_torn_tail_skipped_on_replay(self, tmp_path):
+        j = _mini_journal(tmp_path)
+        j.append("proposed", trial="t1", data={"trial": _trial()})
+        j.append("settled", trial="t1", data={"trial": _trial("Succeeded")})
+        j.close()
+        path = jr.journal_path(str(tmp_path), "crash-exp")
+        with open(path, "ab") as f:
+            f.write(b'{"seq": 3, "event": "settl')  # crash mid-append
+        state, stats = jr.replay_journal(str(tmp_path), "crash-exp")
+        assert stats.applied == 2
+        assert stats.torn_bytes > 0
+        assert state["trials"]["t1"]["condition"] == "Succeeded"
+
+    def test_torn_tail_truncated_on_reopen(self, tmp_path):
+        j = _mini_journal(tmp_path)
+        j.append("proposed", trial="t1", data={"trial": _trial()})
+        j.close()
+        path = jr.journal_path(str(tmp_path), "crash-exp")
+        valid = os.path.getsize(path)
+        with open(path, "ab") as f:
+            f.write(b"garbage that is not json\n" + b'{"half')
+        j2 = _mini_journal(tmp_path)  # reopen truncates
+        assert os.path.getsize(path) == valid
+        # and the seq clock continues from the valid prefix, not the garbage
+        seq = j2.append("settled", trial="t1", data={"trial": _trial("Succeeded")})
+        j2.close()
+        assert seq == 2
+        state, stats = jr.replay_journal(str(tmp_path), "crash-exp")
+        assert stats.torn_bytes == 0 and stats.applied == 2
+
+    def test_mid_file_corruption_is_skipped_not_torn(self, tmp_path):
+        j = _mini_journal(tmp_path)
+        j.append("proposed", trial="t1", data={"trial": _trial()})
+        j.append("proposed", trial="t2", data={"trial": _trial()})
+        j.close()
+        path = jr.journal_path(str(tmp_path), "crash-exp")
+        lines = open(path, "rb").read().splitlines(keepends=True)
+        with open(path, "wb") as f:
+            f.write(lines[0])
+            f.write(b'{"seq": 99, "crc": "00000000", "bitrot": tru\n')
+            f.write(lines[1])
+        state, stats = jr.replay_journal(str(tmp_path), "crash-exp")
+        assert stats.bad_records == 1
+        assert stats.torn_bytes == 0
+        assert set(state["trials"]) == {"t1", "t2"}
+
+    def test_checksum_rejects_tampered_record(self, tmp_path):
+        j = _mini_journal(tmp_path)
+        j.append("settled", trial="t1", data={"trial": _trial("Succeeded")})
+        j.close()
+        path = jr.journal_path(str(tmp_path), "crash-exp")
+        raw = open(path).read().replace("Succeeded", "Failedddd")
+        with open(path, "w") as f:
+            f.write(raw)
+        _, stats = jr.replay_journal(str(tmp_path), "crash-exp")
+        assert stats.applied == 0  # crc mismatch -> record refused
+
+
+class TestCompactionEquivalence:
+    def _feed(self, j):
+        for i in range(6):
+            name = f"t{i}"
+            j.append("proposed", trial=name, data={"trial": _trial()})
+            j.append(
+                "settled",
+                trial=name,
+                data={
+                    "trial": _trial("Succeeded", observation=[["accuracy", 0.1 * i]]),
+                    "exp": {"condition": "Running"},
+                },
+            )
+
+    def test_snapshot_replay_equals_full_log_replay(self, tmp_path):
+        full_dir = tmp_path / "full"
+        comp_dir = tmp_path / "comp"
+        jf = jr.ExperimentJournal(str(full_dir), "e", snapshot_every=10**6)
+        jc = jr.ExperimentJournal(str(comp_dir), "e", snapshot_every=4)
+        self._feed(jf)
+        for i in range(6):
+            name = f"t{i}"
+            jc.append("proposed", trial=name, data={"trial": _trial()})
+            jc.append(
+                "settled",
+                trial=name,
+                data={
+                    "trial": _trial("Succeeded", observation=[["accuracy", 0.1 * i]]),
+                    "exp": {"condition": "Running"},
+                },
+            )
+            # compact mid-stream with the replayed state as the snapshot,
+            # exactly like the orchestrator snapshots experiment_to_dict
+            if jc.maybe_compact(
+                lambda: jr.replay_journal(str(comp_dir), "e")[0]
+            ):
+                assert jr.list_snapshots(str(comp_dir / "e"))
+        jf.close()
+        jc.close()
+        full_state, full_stats = jr.replay_journal(str(full_dir), "e")
+        comp_state, comp_stats = jr.replay_journal(str(comp_dir), "e")
+        assert comp_stats.snapshot_seq is not None
+        assert comp_state["trials"] == full_state["trials"]
+        assert comp_state["condition"] == full_state["condition"]
+
+    def test_leftover_records_below_snapshot_are_stale_not_reapplied(
+        self, tmp_path
+    ):
+        """Crash between snapshot-write and journal-truncate leaves records
+        at/below the snapshot seq; replay must drop them."""
+        j = _mini_journal(tmp_path)
+        j.append("proposed", trial="t1", data={"trial": _trial()})
+        j.append(
+            "settled", trial="t1", data={"trial": _trial("Succeeded", retry_count=0)}
+        )
+        j.close()
+        # snapshot manually at seq 2 WITHOUT truncating (the crash window)
+        state, _ = jr.replay_journal(str(tmp_path), "crash-exp")
+        doc_state = state
+        import zlib
+
+        exp_dir = str(tmp_path / "crash-exp")
+        doc = {
+            "seq": 2,
+            "crc": f"{zlib.crc32(json.dumps(doc_state, sort_keys=True, default=str).encode()) & 0xFFFFFFFF:08x}",
+            "state": doc_state,
+        }
+        with open(os.path.join(exp_dir, "snapshot-000000000002.json"), "w") as f:
+            json.dump(doc, f)
+        state2, stats = jr.replay_journal(str(tmp_path), "crash-exp")
+        assert stats.snapshot_seq == 2
+        assert stats.stale == 2  # both pre-snapshot records dropped
+        assert stats.duplicates == 0
+        assert state2["trials"] == state["trials"]
+
+    def test_double_settle_same_epoch_is_dropped(self, tmp_path):
+        j = _mini_journal(tmp_path)
+        j.append("settled", trial="t1", epoch=0, data={"trial": _trial("Succeeded")})
+        j.append(
+            "settled", trial="t1", epoch=0, data={"trial": _trial("Failed")}
+        )  # replayed duplicate — must NOT demote the trial
+        j.append(
+            "settled", trial="t1", epoch=1, data={"trial": _trial("Failed", 1)}
+        )  # new attempt epoch — a genuine second settlement
+        j.close()
+        state, stats = jr.replay_journal(str(tmp_path), "crash-exp")
+        assert stats.duplicates == 1
+        assert state["trials"]["t1"]["condition"] == "Failed"
+        assert state["trials"]["t1"]["retry_count"] == 1
+
+
+class TestSqliteWalCrash:
+    def test_os_exit_mid_report_never_surfaces_partial_row(self, tmp_path):
+        """Child arms KATIB_CRASH_AT=store.report and dies between INSERT
+        and COMMIT; the WAL database stays readable and the uncommitted row
+        is invisible."""
+        db = str(tmp_path / "observations.sqlite")
+        script = textwrap.dedent(
+            f"""
+            import sys
+            sys.path.insert(0, {_REPO!r})
+            from katib_tpu.core.types import MetricLog
+            from katib_tpu.store.sqlite import SqliteObservationStore
+            s = SqliteObservationStore({db!r})
+            s.report("t0", [MetricLog("accuracy", 0.5, step=0)])  # durable
+            s.report("t0", [MetricLog("accuracy", 0.9, step=1)])  # crash before commit
+            print("UNREACHED")
+            """
+        )
+        env = dict(os.environ)
+        env[faults.CRASH_AT_ENV] = "store.report:2"
+        proc = subprocess.run(
+            [sys.executable, "-c", script],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+        assert proc.returncode == 137, proc.stdout + proc.stderr
+        assert "UNREACHED" not in proc.stdout
+
+        from katib_tpu.store.sqlite import SqliteObservationStore
+
+        s = SqliteObservationStore(db)
+        logs = s.get("t0")
+        assert [(m.metric_name, m.value, m.step) for m in logs] == [
+            ("accuracy", 0.5, 0)
+        ]
+        # the store remains writable after recovery
+        s.report("t0", [MetricLog("accuracy", 0.9, step=1)])
+        assert len(s.get("t0")) == 2
+        s.close()
+
+    def test_replayed_report_upserts_not_duplicates(self, tmp_path):
+        """Exactly-once at the store layer: resume re-reporting the same
+        (trial, metric, step) updates in place."""
+        from katib_tpu.store.sqlite import SqliteObservationStore
+
+        s = SqliteObservationStore(str(tmp_path / "o.sqlite"))
+        s.report("t0", [MetricLog("accuracy", 0.5, step=3)])
+        s.report("t0", [MetricLog("accuracy", 0.5, step=3)])  # replay after crash
+        logs = s.get("t0")
+        assert len(logs) == 1
+        # unstepped rows (parsed log lines, step=-1) keep append semantics
+        s.report("t0", [MetricLog("loss", 1.0)])
+        s.report("t0", [MetricLog("loss", 1.0)])
+        assert len(s.get("t0")) == 3
+        s.close()
+
+
+class TestSuggesterFence:
+    def _exp_with_settlements(self, tmp_path, n=2):
+        j = _mini_journal(tmp_path, name="fence-exp")
+        for i in range(n):
+            j.append(
+                "settled", trial=f"t{i}", data={"trial": _trial("Succeeded")}
+            )
+        j.close()
+        return jr.last_settled_seq(str(tmp_path), "fence-exp")
+
+    def test_stale_pickle_rejected_and_counted(self, tmp_path):
+        from katib_tpu.orchestrator.resume import (
+            load_suggester_state,
+            save_suggester_state,
+        )
+        from katib_tpu.utils import observability as obs
+
+        settled = self._exp_with_settlements(tmp_path)
+        assert settled == 2
+        sug = _StatefulSuggester()
+        save_suggester_state(sug, str(tmp_path), "fence-exp", fence=1)  # stale
+        before = obs.suggester_fence_rebuilds.get()
+        assert (
+            load_suggester_state(
+                sug, str(tmp_path), "fence-exp", settled_fence=settled
+            )
+            is False
+        )
+        assert sug.loaded is None  # the stale state never reached the hook
+        assert obs.suggester_fence_rebuilds.get() == before + 1
+
+    def test_current_pickle_accepted(self, tmp_path):
+        from katib_tpu.orchestrator.resume import (
+            load_suggester_state,
+            save_suggester_state,
+        )
+
+        settled = self._exp_with_settlements(tmp_path)
+        sug = _StatefulSuggester()
+        save_suggester_state(sug, str(tmp_path), "fence-exp", fence=settled)
+        assert (
+            load_suggester_state(
+                sug, str(tmp_path), "fence-exp", settled_fence=settled
+            )
+            is True
+        )
+        assert sug.loaded == {"portfolio": [1, 2, 3]}
+
+    def test_legacy_unfenced_pickle_rejected_when_journal_has_settlements(
+        self, tmp_path
+    ):
+        """A bare pre-fence pickle cannot prove it saw the settled work —
+        with a journal present it is treated as stale."""
+        import pickle
+
+        from katib_tpu.orchestrator.resume import (
+            load_suggester_state,
+            suggester_state_path,
+        )
+
+        settled = self._exp_with_settlements(tmp_path)
+        sug = _StatefulSuggester()
+        with open(suggester_state_path(str(tmp_path), "fence-exp"), "wb") as f:
+            pickle.dump(sug.state_dict(), f)
+        assert (
+            load_suggester_state(
+                sug, str(tmp_path), "fence-exp", settled_fence=settled
+            )
+            is False
+        )
+
+
+class TestFsck:
+    def _damaged_dir(self, tmp_path):
+        j = _mini_journal(tmp_path, name="sick")
+        j.append("proposed", trial="t1", data={"trial": _trial()})
+        j.append("settled", trial="t1", data={"trial": _trial("Succeeded")})
+        j.close()
+        exp_dir = str(tmp_path / "sick")
+        with open(jr.journal_path(str(tmp_path), "sick"), "ab") as f:
+            f.write(b'{"torn')
+        with open(os.path.join(exp_dir, "snapshot-000000000099.json"), "w") as f:
+            f.write('{"seq": 99, "crc": "deadbeef", "state": {}}')
+        return exp_dir
+
+    def test_dry_run_reports_without_touching(self, tmp_path):
+        from katib_tpu.orchestrator.fsck import fsck_experiment
+
+        exp_dir = self._damaged_dir(tmp_path)
+        before = os.path.getsize(jr.journal_path(str(tmp_path), "sick"))
+        report = fsck_experiment(exp_dir, repair=False)
+        assert not report.ok()
+        assert report.torn_tail_bytes > 0
+        assert os.path.getsize(jr.journal_path(str(tmp_path), "sick")) == before
+
+    def test_repair_truncates_and_quarantines_then_idempotent(self, tmp_path):
+        from katib_tpu.orchestrator.fsck import fsck_experiment
+
+        exp_dir = self._damaged_dir(tmp_path)
+        report = fsck_experiment(exp_dir, repair=True)
+        assert report.ok(), report.problems
+        assert len(report.repairs) == 2
+        assert report.snapshots_quarantined
+        # the quarantined snapshot is out of replay's reach
+        state, stats = jr.replay_journal(str(tmp_path), "sick")
+        assert stats.snapshot_seq is None
+        assert state["trials"]["t1"]["condition"] == "Succeeded"
+        again = fsck_experiment(exp_dir, repair=True)
+        assert again.ok() and not again.repairs
+
+    def test_cli_rc_contract(self, tmp_path):
+        """fsck CLI: nonzero on --dry-run damage, zero after repair."""
+        from katib_tpu import cli
+
+        exp_dir = self._damaged_dir(tmp_path)
+        dry = argparse.Namespace(path=exp_dir, dry_run=True)
+        wet = argparse.Namespace(path=exp_dir, dry_run=False)
+        assert cli.cmd_fsck(dry) == 1
+        assert cli.cmd_fsck(wet) == 0
+        assert cli.cmd_fsck(dry) == 0  # clean now
+
+    def test_stale_fence_reported_not_repaired(self, tmp_path):
+        from katib_tpu.orchestrator.fsck import fsck_experiment
+        from katib_tpu.orchestrator.resume import save_suggester_state
+
+        j = _mini_journal(tmp_path, name="fenced")
+        j.append("settled", trial="t1", data={"trial": _trial("Succeeded")})
+        j.close()
+        save_suggester_state(
+            _StatefulSuggester(), str(tmp_path), "fenced", fence=0
+        )
+        report = fsck_experiment(str(tmp_path / "fenced"), repair=True)
+        assert report.fence.startswith("stale")
+        assert report.ok()  # reported, not a failure — resume rebuilds it
