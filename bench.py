@@ -1006,6 +1006,163 @@ def _run_cohort() -> None:
     sys.exit(3)
 
 
+def _async_occupancy_child() -> None:
+    """Measure the async orchestrator (orchestrator/async_loops.py) against
+    the synchronous loop in the regime it targets: a slow suggester (default
+    0.5 s per call — a remote BO service or heavy acquisition optimizer)
+    feeding short trials.  The sync loop pays the suggester on the dispatch
+    critical path once per batch; the async loop banks ``suggestLookahead``
+    proposals so the mesh never waits.  Prints one tagged JSON line with
+    sync/async trials-per-sec, the speedup, and sustained occupancy."""
+    import tempfile
+    import time as _time
+
+    from katib_tpu.core.types import (
+        AlgorithmSpec,
+        ExperimentSpec,
+        FeasibleSpace,
+        ObjectiveSpec,
+        ObjectiveType,
+        ParameterSpec,
+        ParameterType,
+    )
+    from katib_tpu.orchestrator import Orchestrator
+    from katib_tpu.orchestrator import orchestrator as orch_mod
+    from katib_tpu.suggest.base import make_suggester as _real_make
+
+    trials = int(os.environ.get("BENCH_ASYNC_TRIALS", "1000"))
+    delay = float(os.environ.get("BENCH_ASYNC_SUGGEST_DELAY", "0.5"))
+    train_secs = float(os.environ.get("BENCH_ASYNC_TRAIN_SECS", "0.2"))
+    parallel = int(os.environ.get("BENCH_ASYNC_PARALLEL", "8"))
+
+    def train_fn(ctx):
+        _time.sleep(train_secs)
+        ctx.report(step=1, loss=float(ctx.params["x"]) ** 2)
+
+    class _Delayed:
+        def __init__(self, inner):
+            self.inner = inner
+            self.adaptive = inner.adaptive
+            self.spec = inner.spec
+            self.calls = 0
+
+        def get_suggestions(self, experiment, count):
+            self.calls += 1
+            _time.sleep(delay)
+            return self.inner.get_suggestions(experiment, count)
+
+    def sweep(mode: str) -> dict:
+        spec = ExperimentSpec(
+            name=f"bench-async-{mode}",
+            objective=ObjectiveSpec(
+                type=ObjectiveType.MINIMIZE, objective_metric_name="loss"
+            ),
+            algorithm=AlgorithmSpec(name="random", settings={"seed": "7"}),
+            parameters=[
+                ParameterSpec(
+                    "x", ParameterType.DOUBLE, FeasibleSpace(min=-1.0, max=1.0)
+                )
+            ],
+            train_fn=train_fn,
+            parallel_trial_count=parallel,
+            max_trial_count=trials,
+            async_orch=(mode == "async"),
+        )
+        suggester_calls = []
+        orig = orch_mod.make_suggester
+
+        def delayed_make(s):
+            d = _Delayed(_real_make(s))
+            suggester_calls.append(d)
+            return d
+
+        with tempfile.TemporaryDirectory() as wd:
+            orch_mod.make_suggester = delayed_make
+            try:
+                t0 = _time.perf_counter()
+                orch = Orchestrator(workdir=wd)
+                exp = orch.run(spec)
+                elapsed = _time.perf_counter() - t0
+            finally:
+                orch_mod.make_suggester = orig
+        settled = sum(
+            1 for t in exp.trials.values() if t.condition.is_terminal()
+        )
+        block = {
+            "mode": mode,
+            "trials": settled,
+            "elapsed_secs": round(elapsed, 3),
+            "trials_per_sec": round(settled / elapsed, 3),
+            # slot-time actually spent training / slot-time available: an
+            # apples-to-apples occupancy both loops can be scored on
+            "derived_occupancy": round(
+                settled * train_secs / (elapsed * parallel), 4
+            ),
+            "suggester_calls": suggester_calls[0].calls if suggester_calls else 0,
+            "condition": exp.condition.value,
+        }
+        if orch.async_stats is not None:
+            block["sustained_occupancy"] = orch.async_stats["sustained_occupancy"]
+            block["lookahead"] = orch.async_stats["lookahead"]
+        return block
+
+    sync = sweep("sync")
+    async_ = sweep("async")
+    result = {
+        "benchmark": "async_occupancy",
+        "platform": "cpu",
+        "suggest_delay_secs": delay,
+        "train_secs": train_secs,
+        "parallel_trial_count": parallel,
+        "sync": sync,
+        "async": async_,
+        "speedup": round(async_["trials_per_sec"] / sync["trials_per_sec"], 3),
+        "note": (
+            "dispatch-overhead benchmark on CPU: trials sleep "
+            f"{train_secs}s, the suggester {delay}s/call; measures the "
+            "control plane, not the chip"
+        ),
+    }
+    print(_RESULT_TAG + json.dumps(result))
+
+
+def _run_async_occupancy() -> None:
+    """Parent side of ``--async-occupancy``: run the sync-vs-async sweep in
+    a scrubbed-env CPU child and print its JSON line."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the relay
+    env.pop("KATIB_ASYNC_ORCH", None)  # the spec flag drives each arm
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--async-occupancy-child"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env=env,
+    )
+    try:
+        out, err = proc.communicate(timeout=1800)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        print("bench: async-occupancy child timed out", file=sys.stderr)
+        sys.exit(3)
+    for line in (out or "").splitlines():
+        if line.startswith(_RESULT_TAG):
+            try:
+                result = json.loads(line[len(_RESULT_TAG):])
+            except json.JSONDecodeError:
+                continue
+            print(json.dumps(result))
+            return
+    print(
+        f"bench: async-occupancy child failed rc={proc.returncode}:\n"
+        + (err or "")[-2000:],
+        file=sys.stderr,
+    )
+    sys.exit(3)
+
+
 def _run_attempt(
     deadline: float, env: dict | None = None
 ) -> tuple[int, dict | None, str]:
@@ -1064,6 +1221,12 @@ def main() -> None:
         return
     if "--cohort" in sys.argv:
         _run_cohort()
+        return
+    if "--async-occupancy-child" in sys.argv:
+        _async_occupancy_child()
+        return
+    if "--async-occupancy" in sys.argv:
+        _run_async_occupancy()
         return
 
     retries = int(os.environ.get("BENCH_RETRIES", "3"))

@@ -706,6 +706,27 @@ class ExperimentSpec:
     # into every TrialSpec (see TrialSpec.compile_deadline_seconds).
     # None = disabled.
     compile_deadline_seconds: float | None = None
+    # Async orchestrator (podracer-style decoupled suggest/schedule/harvest
+    # loops, orchestrator/async_loops.py): None decides from the
+    # KATIB_ASYNC_ORCH env var (default ON; "0" keeps the legacy
+    # synchronous propose->execute->harvest loop for one release).
+    async_orch: bool | None = None
+    # Async suggest loop: how many proposed-but-undispatched trials to keep
+    # journaled and ready ahead of the scheduler, so suggester latency hides
+    # behind training instead of idling the mesh.  None = auto
+    # (4 x max(parallel_trial_count, effective cohort width)).
+    suggest_lookahead: int | None = None
+    # Async schedule loop backpressure: dispatch new work while measured
+    # device occupancy (busy executor slots / parallel_trial_count) is below
+    # this target; 1.0 keeps every slot busy with one unit queued behind it,
+    # lower values deliberately throttle (e.g. leave headroom for a
+    # co-tenant experiment).
+    occupancy_target: float = 1.0
+    # Async cohort packing: a partially-filled shape bucket flushes after
+    # waiting this long for more compatible ready trials (and immediately
+    # when the remaining max_trial_count budget can never fill it) instead
+    # of waiting indefinitely for a full-width group.
+    cohort_fill_deadline_seconds: float = 2.0
 
     def parameter(self, name: str) -> ParameterSpec:
         for p in self.parameters:

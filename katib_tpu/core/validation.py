@@ -135,6 +135,12 @@ def validate_experiment(spec: ExperimentSpec) -> None:
         errors.append("suggester_max_errors must be >= 1")
     if spec.cohort_width < 1:
         errors.append("cohort_width must be >= 1")
+    if spec.suggest_lookahead is not None and spec.suggest_lookahead < 1:
+        errors.append("suggest_lookahead must be >= 1")
+    if not (0.0 < spec.occupancy_target <= 1.0):
+        errors.append("occupancy_target must be in (0, 1]")
+    if spec.cohort_fill_deadline_seconds < 0:
+        errors.append("cohort_fill_deadline_seconds must be >= 0")
     if spec.cohort_width > 1 and spec.command is not None:
         # cohorts vectorize a white-box JAX program; a subprocess argv has
         # no train step to vmap

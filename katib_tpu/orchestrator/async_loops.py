@@ -1,0 +1,680 @@
+"""Podracer-style asynchronous orchestration: three decoupled loops joined
+by bounded queues (the Podracer architectures pattern from PAPERS.md applied
+to HPO control flow).
+
+The synchronous run loop interleaves propose -> execute -> harvest on one
+thread, so the mesh idles whenever the suggester is thinking, a cohort is
+short of members, or harvest is settling.  This engine splits the loop:
+
+- **suggest loop** (thread): keeps ``suggest_lookahead`` proposals journaled
+  and ready ahead of the scheduler, so suggester latency hides behind
+  training instead of gating dispatch.  Budget-aware: never materializes
+  past ``max_trial_count``.
+- **schedule loop** (thread): heterogeneous cohort packing — ready trials
+  accumulate into per-key shape buckets (``compile/buckets.py`` pads the
+  dispatched width to a power of two, so a 5-member flush reuses the
+  8-wide executable) and flush on *any* of: full width, the
+  ``cohort_fill_deadline_seconds`` timeout, suggester exhaustion, or a
+  remaining budget that can never fill the bucket — a partial cohort never
+  waits indefinitely.  Dispatch backpressure is driven by slot occupancy
+  (``occupancy_target``) rather than a fixed trial count, and each flushed
+  bucket's compile signature feeds the prewarm worker before submit.
+- **harvest loop** (the caller's thread): settles completions through the
+  exactly-once journal path (``Orchestrator._harvest``) and owns terminal
+  verdicts, stop, drain, and the livelock guard.
+
+The event journal is the coordination substrate: ``proposed`` (suggest),
+``queued`` (entered a packing bucket), ``started`` (dispatched) and the
+existing ``settled`` records mean a crash at any hand-off point leaves
+non-terminal trials that resume re-seeds into the ready queue —
+exactly-once settlement keyed on (trial, retry epoch) is unchanged.
+
+Locking discipline (acquire order: state > queue > futures):
+
+- ``_state_lock`` — inserts into ``exp.trials`` (materialize) vs the
+  iterations harvest / ``update_optimal`` / terminal checks perform.  The
+  suggester call itself runs OUTSIDE the lock (only its own thread
+  inserts), so a slow suggester never stalls settlement or dispatch.
+- ``_queue_lock`` — the ready deque, packing buckets, and dispatch queue
+  move atomically, so the terminal check can never observe a trial
+  "in neither queue nor futures" mid-hand-off.
+- ``_futures_lock`` — the shared futures dict (scheduler inserts while
+  harvest iterates).
+
+Pool threads (``_execute`` / ``_execute_cohort``) take no engine locks, so
+the mesh critical path is untouched.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+import traceback
+
+from katib_tpu.core.types import (
+    COHORT_KEY_LABEL,
+    Experiment,
+    ExperimentCondition,
+    Trial,
+    TrialCondition,
+)
+from katib_tpu.runner.cohort import cohort_fn_of
+from katib_tpu.suggest.base import call_suggester
+from katib_tpu.utils import observability as obs
+
+#: how long the wind-down waits for the suggest/schedule threads to notice
+#: the halt flag (a suggester blocked mid-call is abandoned on its daemon
+#: thread — the breaker/watchdog own misbehaving suggesters, not drain)
+_JOIN_TIMEOUT = 5.0
+
+#: livelock guard threshold, matching the synchronous loop's 30s stall cap
+_STALL_SECONDS = 30.0
+
+
+class OccupancyMeter:
+    """Time-weighted mean busy-slot fraction.
+
+    The clock starts lazily at the FIRST dispatch (running > 0), so the
+    unavoidable cold ramp — the first suggester call before any trial can
+    exist — does not dilute the sustained number; what is measured is
+    "once work started flowing, how full did the mesh stay".
+    """
+
+    def __init__(self, slots: int):
+        self.slots = max(1, int(slots))
+        self._t0: float | None = None
+        self._last = 0.0
+        self._frac = 0.0
+        self._area = 0.0
+
+    def update(self, busy: int) -> float:
+        now = time.monotonic()
+        frac = min(1.0, busy / self.slots)
+        if self._t0 is None:
+            if busy <= 0:
+                return frac
+            self._t0 = self._last = now
+            self._frac = frac
+            return frac
+        self._area += self._frac * (now - self._last)
+        self._last = now
+        self._frac = frac
+        return frac
+
+    def elapsed(self) -> float:
+        return 0.0 if self._t0 is None else self._last - self._t0
+
+    def sustained(self) -> float:
+        el = self.elapsed()
+        return (self._area / el) if el > 0 else 0.0
+
+
+class AsyncLoops:
+    """One experiment's async engine; ``run()`` replaces the synchronous
+    while-loop body inside ``Orchestrator.run``'s pool context and returns
+    the terminal (or drained) experiment."""
+
+    def __init__(
+        self,
+        orch,
+        exp: Experiment,
+        suggester,
+        early_stopper,
+        mesh,
+        pool,
+        breaker,
+        stop_event: threading.Event,
+        drain_event: threading.Event,
+        futures: dict,
+        initial_ready: list[Trial] = (),
+    ):
+        self.orch = orch
+        self.exp = exp
+        self.spec = exp.spec
+        self.suggester = suggester
+        self.early_stopper = early_stopper
+        self.mesh = mesh
+        self.pool = pool
+        self.breaker = breaker
+        self.stop_event = stop_event
+        self.drain_event = drain_event
+        self.futures = futures
+
+        self._state_lock = threading.Lock()
+        self._queue_lock = threading.Lock()
+        self._futures_lock = threading.Lock()
+
+        #: proposed trials awaiting packing (suggest -> schedule hand-off)
+        self._ready: collections.deque[Trial] = collections.deque(initial_ready)
+        #: per-cohort-key packing buckets + first-arrival timestamps
+        self._packing: dict[str, list[Trial]] = {}
+        self._pack_ts: dict[str, float] = {}
+        #: flushed units awaiting a free slot (schedule -> pool hand-off)
+        self._dispatchq: collections.deque[list[Trial]] = collections.deque()
+
+        self._halt = threading.Event()       # internal: stop both loops
+        self._exhausted = threading.Event()  # suggester returned exhausted
+        self._suggest_inflight = False       # a get_suggestions call is running
+        self._suggester_busy = False         # erroring / cooling down, not idle
+        self._errors: list[str] = []
+        self._last_activity = time.monotonic()
+        #: members dispatched since engine start (consumption-rate estimator
+        #: for the suggest loop's anticipatory refill)
+        self._dispatched_total = 0
+        self._consumed_last_call = 0
+        #: set by _submit; the harvest loop owes a status.json publish
+        self._publish_dirty = False
+
+        spec = self.spec
+        trial_devices = 1
+        if mesh is not None:
+            from katib_tpu.parallel.mesh import trial_axis_size
+
+            trial_devices = trial_axis_size(mesh)
+        self.width = max(spec.cohort_width, trial_devices)
+        self._use_cohorts = self.width > 1 and cohort_fn_of(spec.train_fn) is not None
+        self._default_key = spec.cohort_key or (
+            orch._TRIAL_MESH_KEY if trial_devices > 1 else None
+        )
+        # proposal lookahead: deep for non-adaptive suggesters (the points
+        # never depend on results), clamped to the in-flight width for
+        # adaptive ones (ASHA/BO/PBT) — racing them ahead of observations
+        # burns the budget on uninformed proposals (see Suggester.adaptive)
+        base_width = max(spec.parallel_trial_count, self.width)
+        self.lookahead = spec.suggest_lookahead or (
+            base_width if getattr(suggester, "adaptive", True) else 4 * base_width
+        )
+        # occupancy backpressure, counted in MEMBER trials (a cohort future
+        # carries width members on one slot): ``parallel_trial_count`` is
+        # the concurrency contract the sync loop enforces via _shortfall,
+        # scaled down by occupancy_target to deliberately throttle.  A unit
+        # wider than the limit dispatches alone (the sync loop can never
+        # build one, but an explicit suggestLookahead + wide mesh can).
+        self.member_limit = max(
+            1, round(spec.parallel_trial_count * spec.occupancy_target)
+        )
+        self.meter = OccupancyMeter(spec.parallel_trial_count)
+
+    # -- entry point ---------------------------------------------------------
+
+    def run(self) -> Experiment:
+        self._threads = [
+            threading.Thread(
+                target=self._suggest_loop,
+                name=f"suggest-{self.exp.name}",
+                daemon=True,
+            ),
+            threading.Thread(
+                target=self._schedule_loop,
+                name=f"schedule-{self.exp.name}",
+                daemon=True,
+            ),
+        ]
+        for t in self._threads:
+            t.start()
+        try:
+            return self._harvest_loop()
+        finally:
+            self._stop_loops()
+            obs.pending_proposals.set(0.0)
+
+    # -- suggest loop --------------------------------------------------------
+
+    def _suggest_loop(self) -> None:
+        orch, exp, spec = self.orch, self.exp, self.spec
+        try:
+            while not self._halt.is_set():
+                if self._exhausted.is_set():
+                    return
+                # anticipatory refill: a refill of exactly (lookahead -
+                # queued) arrives one suggester-latency late, by which time
+                # the scheduler has consumed ~latency*throughput more — at
+                # steady state the bank sits that much below target and the
+                # mesh starves briefly every cycle.  Adding the members
+                # consumed during the LAST call (a one-step rate estimate)
+                # keeps the bank at the full lookahead when the call lands.
+                want = (
+                    self.lookahead
+                    - self._queued_count()
+                    + self._consumed_last_call
+                )
+                if spec.max_trial_count is not None:
+                    want = min(want, spec.max_trial_count - len(exp.trials))
+                if want <= 0:
+                    self._halt.wait(orch.poll_interval)
+                    continue
+                if not self.breaker.allow():
+                    # cooling down after an error: not idle, not progress
+                    self._suggester_busy = True
+                    self._last_activity = time.monotonic()
+                    self._halt.wait(orch.poll_interval)
+                    continue
+                self._suggester_busy = False
+                sug_start = orch._tracer.elapsed() if orch._tracer else 0.0
+                t0 = time.perf_counter()
+                d0 = self._dispatched_total
+                self._suggest_inflight = True
+                try:
+                    proposals, outcome = call_suggester(
+                        self.suggester, exp, want, self.breaker, orch.fault_injector
+                    )
+                finally:
+                    self._suggest_inflight = False
+                self._consumed_last_call = self._dispatched_total - d0
+                dur = time.perf_counter() - t0
+                obs.suggestion_latency.observe(dur, algorithm=spec.algorithm.name)
+                obs.suggest_seconds.observe(dur, algorithm=spec.algorithm.name)
+                if orch._tracer is not None and (
+                    proposals or outcome in ("exhausted", "error") or dur >= 1e-3
+                ):
+                    orch._tracer.record(
+                        "suggest",
+                        sug_start,
+                        dur,
+                        algorithm=spec.algorithm.name,
+                        count=len(proposals),
+                        outcome=outcome,
+                    )
+                if outcome == "error":
+                    self._suggester_busy = True
+                    self._last_activity = time.monotonic()
+                    obs.suggester_errors.inc(algorithm=spec.algorithm.name)
+                if proposals:
+                    with self._state_lock:
+                        trials = [
+                            orch._materialize(
+                                exp,
+                                p,
+                                # rules attach at DISPATCH (_refresh_rules),
+                                # not here: a lookahead proposal materializes
+                                # long before the history its rule snapshot
+                                # would need
+                                None,
+                                self.suggester,
+                                condition=TrialCondition.PENDING,
+                                journal=False,
+                            )
+                            for p in proposals
+                        ]
+                    # one durability barrier for the whole refill — per-trial
+                    # appends would serialize ~lookahead fsyncs between the
+                    # suggester returning and the first dispatch
+                    orch._jappend_group("proposed", exp, trials)
+                    with self._queue_lock:
+                        self._ready.extend(trials)
+                    self._update_pending_gauge()
+                    with self._state_lock:
+                        orch._persist_suggester(exp, self.suggester)
+                        orch._publish(exp)
+                    self._last_activity = time.monotonic()
+                if outcome == "exhausted":
+                    # set AFTER the final proposals are queued, so the
+                    # terminal check never sees "exhausted + empty" early
+                    self._exhausted.set()
+                    return
+                if not proposals:
+                    self._halt.wait(orch.poll_interval)
+        except Exception:
+            self._errors.append(
+                "suggest loop error:\n" + traceback.format_exc(limit=20)
+            )
+            self._halt.set()
+
+    # -- schedule loop -------------------------------------------------------
+
+    def _schedule_loop(self) -> None:
+        orch = self.orch
+        try:
+            while not self._halt.is_set():
+                moved = self._pack_ready()
+                flushed = self._flush_buckets()
+                dispatched = self._dispatch_units()
+                if moved or flushed or dispatched:
+                    self._update_pending_gauge()
+                else:
+                    self._halt.wait(orch.poll_interval)
+        except Exception:
+            self._errors.append(
+                "schedule loop error:\n" + traceback.format_exc(limit=20)
+            )
+            self._halt.set()
+
+    def _cohort_key_for(self, trial: Trial) -> str | None:
+        if not self._use_cohorts:
+            return None
+        key = trial.spec.labels.get(COHORT_KEY_LABEL) or self._default_key
+        if key:
+            # stamp it back so the journal/UI show which bucket it rode in
+            trial.spec.labels.setdefault(COHORT_KEY_LABEL, key)
+        return key
+
+    def _pack_ready(self) -> int:
+        """Move ready trials into packing buckets (keyless -> straight to
+        the dispatch queue as singletons).  Journals the ``queued``
+        hand-off records as one batched durability barrier."""
+        moved: list[Trial] = []
+        prewarms: list[list[Trial]] = []
+        while True:
+            with self._queue_lock:
+                if not self._ready:
+                    break
+                trial = self._ready.popleft()
+                key = self._cohort_key_for(trial)
+                if key is None:
+                    self._dispatchq.append([trial])
+                else:
+                    bucket = self._packing.setdefault(key, [])
+                    if not bucket:
+                        self._pack_ts[key] = time.monotonic()
+                    bucket.append(trial)
+                    if len(bucket) & (len(bucket) - 1) == 0:
+                        # speculative prewarm at each power-of-two fill
+                        # level: the bucketed executable for the current
+                        # size compiles while the bucket keeps filling
+                        # (dedup in the worker makes superseded sizes
+                        # cheap no-ops)
+                        prewarms.append(list(bucket))
+            moved.append(trial)
+        if moved:
+            self.orch._jappend_group("queued", self.exp, moved)
+        for peek in prewarms:
+            self.orch._submit_prewarm(self.spec, peek, self.mesh)
+        return len(moved)
+
+    def _flush_buckets(self) -> int:
+        """Flush full buckets always; flush PARTIAL buckets when the fill
+        deadline expires, the suggester is exhausted, or the remaining
+        proposal budget can never complete them — the fix for a remainder
+        smaller than the cohort width waiting forever."""
+        spec = self.spec
+        flushed = 0
+        now = time.monotonic()
+        budget_left = (
+            spec.max_trial_count - len(self.exp.trials)
+            if spec.max_trial_count is not None
+            else None
+        )
+        with self._queue_lock:
+            for key in list(self._packing):
+                bucket = self._packing[key]
+                while len(bucket) >= self.width:
+                    self._dispatchq.append(bucket[: self.width])
+                    del bucket[: self.width]
+                    self._pack_ts[key] = now
+                    flushed += 1
+                if not bucket:
+                    del self._packing[key]
+                    self._pack_ts.pop(key, None)
+                    continue
+                deadline_hit = (
+                    now - self._pack_ts.get(key, now)
+                    >= spec.cohort_fill_deadline_seconds
+                )
+                starved = self._exhausted.is_set() or (
+                    budget_left is not None
+                    and budget_left <= 0
+                    and not self._ready
+                )
+                if deadline_hit or starved:
+                    self._dispatchq.append(list(bucket))
+                    del self._packing[key]
+                    self._pack_ts.pop(key, None)
+                    flushed += 1
+        return flushed
+
+    def _undone_members(self) -> int:
+        # called under _futures_lock
+        return sum(
+            (len(o) if isinstance(o, list) else 1)
+            for f, o in self.futures.items()
+            if not f.done()
+        )
+
+    def _dispatch_units(self) -> int:
+        """Submit queued units while occupancy allows.  The hand-off from
+        dispatch queue to futures dict is atomic under the queue lock, so
+        the terminal check never sees a unit in neither."""
+        n = 0
+        orch = self.orch
+        while not self._halt.is_set():
+            # drain/stop freeze dispatch immediately: a draining trial's
+            # early return must not free a slot for a NEW trial in the
+            # window before the harvest loop acts on the request (queued
+            # units become PENDING leftovers / cancelled instead)
+            if (
+                orch._drain_requested.is_set()
+                or orch._stop_requested.is_set()
+                or self.stop_event.is_set()
+            ):
+                return n
+            with self._queue_lock:
+                if not self._dispatchq:
+                    return n
+                unit = self._dispatchq[0]
+                with self._futures_lock:
+                    undone = self._undone_members()
+                if undone > 0 and undone + len(unit) > self.member_limit:
+                    return n
+            # early-stopping rules snapshot at DISPATCH time, not propose
+            # time: lookahead materializes trials before any history
+            # exists, so a rule frozen at _materialize would be
+            # permanently empty.  Outside the queue lock (state > queue
+            # ordering); the head is stable because this thread is the
+            # only popper while the loops run.
+            self._refresh_rules(unit)
+            with self._queue_lock:
+                if not self._dispatchq or self._dispatchq[0] is not unit:
+                    continue
+                self._dispatchq.popleft()
+                self._submit(unit)
+            n += 1
+        return n
+
+    def _refresh_rules(self, unit: list[Trial]) -> None:
+        es = self.early_stopper
+        if es is None:
+            return
+        # settle completed-but-unharvested futures first: sub-second
+        # trials outrun the harvest poll, and the median needs every
+        # finished trial counted as SUCCEEDED, not merely future-done
+        with self._state_lock, self._futures_lock:
+            self.orch._harvest(self.exp, self.futures)
+            rules = es.get_rules(self.exp)
+        if not rules:
+            return
+        for t in unit:
+            if not t.spec.early_stopping_rules:
+                t.spec.early_stopping_rules = rules
+
+    def _submit(self, unit: list[Trial]) -> None:
+        # called under _queue_lock
+        orch, exp = self.orch, self.exp
+        orch._submit_prewarm(self.spec, unit, self.mesh)
+        now = time.time()
+        for t in unit:
+            t.condition = TrialCondition.RUNNING
+            t.start_time = now
+        orch._jappend_group("started", exp, unit)
+        if len(unit) == 1:
+            fut = self.pool.submit(orch._execute, exp, unit[0], self.mesh)
+            owner: Trial | list[Trial] = unit[0]
+        else:
+            fut = self.pool.submit(orch._execute_cohort, exp, unit, self.mesh)
+            owner = unit
+        with self._futures_lock:
+            self.futures[fut] = owner
+        self._dispatched_total += len(unit)
+        self._last_activity = time.monotonic()
+        # the harvest loop republishes status.json soon after: without
+        # this, a run whose trials all dispatch between publishes would
+        # never show a Running trial to external watchers
+        self._publish_dirty = True
+
+    # -- harvest loop (caller thread) ---------------------------------------
+
+    def _harvest_loop(self) -> Experiment:
+        orch, exp = self.orch, self.exp
+        while True:
+            if self._errors:
+                raise RuntimeError("; ".join(self._errors))
+            with self._state_lock, self._futures_lock:
+                orch._harvest(exp, self.futures)
+            with self._futures_lock:
+                # busy in MEMBER trials: a running cohort future fills
+                # width slots' worth of the mesh on one pool thread
+                busy = sum(
+                    (len(o) if isinstance(o, list) else 1)
+                    for f, o in self.futures.items()
+                    if f.running()
+                )
+                undone = sum(1 for f in self.futures if not f.done())
+            obs.mesh_occupancy.set(self.meter.update(busy))
+            if self._publish_dirty:
+                self._publish_dirty = False
+                with self._state_lock:
+                    orch._publish(exp)
+
+            if orch._stop_requested.is_set():
+                self.stop_event.set()
+            if self.stop_event.is_set():
+                return self._terminal(
+                    ExperimentCondition.FAILED, message="experiment stopped"
+                )
+            if orch._drain_requested.is_set():
+                return self._drain()
+
+            queued = self._queued_count()
+            exhausted_eff = self._exhausted.is_set() and queued == 0
+            with self._state_lock:
+                verdict = orch._check_terminal(exp, exhausted_eff, self.futures)
+            if verdict is not None:
+                return self._terminal(verdict)
+
+            if self.breaker.tripped:
+                return self._terminal(
+                    ExperimentCondition.FAILED,
+                    message=(
+                        f"suggester failed {self.breaker.failures} consecutive "
+                        f"times (suggester_max_errors="
+                        f"{self.spec.suggester_max_errors}); last error:\n"
+                        + self.breaker.last_failure
+                    ),
+                )
+
+            # livelock guard (the sync loop's 30s stall cap): nothing in
+            # flight, nothing queued, suggester idle and answering nothing
+            if (
+                undone == 0
+                and queued == 0
+                and not self._exhausted.is_set()
+                and not self._suggester_busy
+                and not self._suggest_inflight
+            ):
+                if time.monotonic() - self._last_activity > _STALL_SECONDS:
+                    return self._terminal(
+                        ExperimentCondition.FAILED,
+                        message=(
+                            "orchestrator stalled: suggester proposes nothing "
+                            "with no trials in flight"
+                        ),
+                    )
+            else:
+                self._last_activity = max(self._last_activity, time.monotonic() - 1.0)
+            time.sleep(orch.poll_interval)
+
+    # -- wind-down -----------------------------------------------------------
+
+    def _queued_count(self) -> int:
+        with self._queue_lock:
+            return (
+                len(self._ready)
+                + sum(len(b) for b in self._packing.values())
+                + sum(len(u) for u in self._dispatchq)
+            )
+
+    def _update_pending_gauge(self) -> None:
+        obs.pending_proposals.set(float(self._queued_count()))
+
+    def _drain_queues(self) -> list[Trial]:
+        with self._queue_lock:
+            leftovers = list(self._ready)
+            self._ready.clear()
+            for bucket in self._packing.values():
+                leftovers.extend(bucket)
+            self._packing.clear()
+            self._pack_ts.clear()
+            for unit in self._dispatchq:
+                leftovers.extend(unit)
+            self._dispatchq.clear()
+        return leftovers
+
+    def _stop_loops(self) -> None:
+        """Halt the suggest/schedule threads and JOIN them before the
+        caller touches the queues or cancels futures — without the join, a
+        dispatch racing the wind-down could submit a unit after
+        ``_cancel_pending`` already ran."""
+        self._halt.set()
+        for t in getattr(self, "_threads", ()):
+            if t is not threading.current_thread():
+                t.join(timeout=_JOIN_TIMEOUT)
+
+    def _terminal(
+        self, verdict: ExperimentCondition, message: str | None = None
+    ) -> Experiment:
+        orch, exp = self.orch, self.exp
+        self._stop_loops()
+        self.stop_event.set()
+        with self._futures_lock:
+            orch._cancel_pending(self.futures)
+        with self._state_lock, self._futures_lock:
+            orch._harvest(exp, self.futures, wait_running=True)
+        # proposed-but-undispatched trials mirror the sync loop's
+        # cancelled-future semantics: settled KILLED, budget consumed
+        now = time.time()
+        for t in self._drain_queues():
+            t.condition = TrialCondition.KILLED
+            t.message = "cancelled: experiment terminal before dispatch"
+            t.completion_time = now
+            if not t.start_time:
+                t.start_time = now
+            obs.trials_killed.inc()
+            orch._jappend("settled", exp, trial=t)
+            orch._observe_trial_duration(t)
+        exp.condition = verdict
+        exp.message = message if message is not None else orch._terminal_message(verdict)
+        exp.completion_time = time.time()
+        exp.update_optimal()
+        self._record_stats()
+        orch._finish(exp)
+        return exp
+
+    def _drain(self) -> Experiment:
+        orch, exp = self.orch, self.exp
+        self._stop_loops()
+        # undispatched trials never started: back to PENDING so the resumed
+        # run re-seeds them into its ready queue (no budget slot consumed)
+        for t in self._drain_queues():
+            t.condition = TrialCondition.PENDING
+            t.message = "drained before start; resubmitted on resume"
+            orch._jappend("drained", exp, trial=t)
+        self._record_stats()
+        return orch._drain_and_exit(
+            exp, self.futures, self.suggester, self.stop_event, self.drain_event
+        )
+
+    def _record_stats(self) -> None:
+        """Publish the run's sustained-occupancy summary for bench/CI."""
+        exp = self.exp
+        elapsed = self.meter.elapsed()
+        settled = sum(1 for t in exp.trials.values() if t.condition.is_terminal())
+        self.orch.async_stats = {
+            "sustained_occupancy": round(self.meter.sustained(), 4),
+            "elapsed_s": round(elapsed, 4),
+            "trials_settled": settled,
+            "trials_per_sec": round(settled / elapsed, 4) if elapsed > 0 else 0.0,
+            "lookahead": self.lookahead,
+            "width": self.width,
+            "member_limit": self.member_limit,
+        }
+        obs.mesh_occupancy.set(0.0)

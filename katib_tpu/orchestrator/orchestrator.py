@@ -141,6 +141,10 @@ class Orchestrator:
         self._tracer: tracing.Tracer | None = None
         self._prev_tracer: tracing.Tracer | None = None
         self._exp_span_start = 0.0
+        #: sustained-occupancy / throughput summary of the most recent async
+        #: run (orchestrator/async_loops.py); None under the sync path —
+        #: bench.py and the CI async smoke read it after run() returns
+        self.async_stats: dict | None = None
 
     def stop(self) -> None:
         """Request the experiment wind down (the reference's experiment
@@ -342,24 +346,59 @@ class Orchestrator:
                 self._finish(exp)
                 raise RuntimeError(exp.message)
 
+        # Podracer-style async engine (orchestrator/async_loops.py): default
+        # ON; spec.async_orch wins, else the KATIB_ASYNC_ORCH env var — "0"
+        # is the one-release escape hatch back to the synchronous loop
+        self.async_stats = None
+        use_async = (
+            spec.async_orch
+            if spec.async_orch is not None
+            else os.environ.get("KATIB_ASYNC_ORCH", "1") != "0"
+        )
+
         with cf.ThreadPoolExecutor(
             max_workers=spec.parallel_trial_count, thread_name_prefix=f"trial-{exp.name}"
         ) as pool:
           try:
-            # resubmit trials orphaned by a process restart (journaled
-            # non-terminal → PENDING): same name/assignments/checkpoint dir,
-            # so a checkpoint-aware train_fn resumes mid-trial — the analog
-            # of trial jobs surviving a controller restart in the reference
+            # trials orphaned by a process restart (journaled non-terminal →
+            # PENDING): same name/assignments/checkpoint dir, so a
+            # checkpoint-aware train_fn resumes mid-trial — the analog of
+            # trial jobs surviving a controller restart in the reference.
+            # The sync loop resubmits them directly; the async engine seeds
+            # them into its ready queue so they flow through cohort packing
+            # and occupancy backpressure like any other proposal.
+            orphans: list[Trial] = []
             for trial in exp.trials.values():
                 if trial.condition in (TrialCondition.PENDING, TrialCondition.CREATED):
                     if early_stopper is not None and not trial.spec.early_stopping_rules:
                         trial.spec.early_stopping_rules = early_stopper.get_rules(exp)
                     if hasattr(suggester, "checkpoint_dir_for"):
                         self._suggester_owned_ckpts.add(trial.name)
+                    if use_async:
+                        trial.condition = TrialCondition.PENDING
+                        orphans.append(trial)
+                        continue
                     trial.condition = TrialCondition.RUNNING
                     trial.start_time = time.time()
                     self._jappend("started", exp, trial=trial)
                     futures[pool.submit(self._execute, exp, trial, mesh)] = trial
+            if use_async:
+                from katib_tpu.orchestrator.async_loops import AsyncLoops
+
+                engine = AsyncLoops(
+                    self,
+                    exp,
+                    suggester,
+                    early_stopper,
+                    mesh,
+                    pool,
+                    breaker,
+                    stop_event,
+                    drain_event,
+                    futures,
+                    initial_ready=orphans,
+                )
+                return engine.run()
             while True:
                 self._harvest(exp, futures)
                 if self._stop_requested.is_set():
@@ -589,7 +628,42 @@ class Orchestrator:
         except (OSError, ValueError):
             pass
 
-    def _materialize(self, exp: Experiment, proposal, early_stopper, suggester) -> Trial:
+    def _jappend_group(
+        self, event: str, exp: Experiment, trials: list[Trial]
+    ) -> None:
+        """Journal one state transition for a batch of trials with a single
+        durability barrier (``Journal.append_group``) — the async engine's
+        bulk hand-offs would otherwise pay one fsync per trial."""
+        j = self._journal
+        if j is None or not trials:
+            return
+        try:
+            from katib_tpu.orchestrator.status import trial_to_dict
+
+            exp_state = self._journal_exp_state(exp)
+            j.append_group(
+                [
+                    (
+                        event,
+                        t.name,
+                        t.retry_count,
+                        {"exp": exp_state, "trial": trial_to_dict(t)},
+                    )
+                    for t in trials
+                ]
+            )
+        except (OSError, ValueError):
+            pass
+
+    def _materialize(
+        self,
+        exp: Experiment,
+        proposal,
+        early_stopper,
+        suggester,
+        condition: TrialCondition = TrialCondition.RUNNING,
+        journal: bool = True,
+    ) -> Trial:
         name = proposal.name or f"{exp.name}-{secrets.token_hex(4)}"
         rules = list(proposal.early_stopping_rules)
         if early_stopper is not None and not rules:
@@ -618,12 +692,17 @@ class Orchestrator:
                 progress_deadline_seconds=exp.spec.progress_deadline_seconds,
                 compile_deadline_seconds=exp.spec.compile_deadline_seconds,
             ),
-            condition=TrialCondition.RUNNING,
-            start_time=time.time(),
+            # async proposals wait in the ready queue as PENDING (started at
+            # dispatch); the sync loop submits immediately as RUNNING
+            condition=condition,
+            start_time=time.time() if condition is TrialCondition.RUNNING else 0.0,
             checkpoint_dir=ckpt,
         )
         exp.trials[name] = trial
-        self._jappend("proposed", exp, trial=trial)
+        # journal=False lets the async engine batch a whole refill's
+        # ``proposed`` records into one append_group durability barrier
+        if journal:
+            self._jappend("proposed", exp, trial=trial)
         obs.trials_created.inc()
         return trial
 

@@ -363,6 +363,16 @@ def experiment_spec_from_dict(data: Mapping[str, Any]) -> ExperimentSpec:
             if spec.get("compileDeadlineSeconds") is not None
             else None
         ),
+        async_orch=(
+            bool(spec["asyncOrch"]) if spec.get("asyncOrch") is not None else None
+        ),
+        suggest_lookahead=(
+            int(spec["suggestLookahead"])
+            if spec.get("suggestLookahead") is not None
+            else None
+        ),
+        occupancy_target=float(spec.get("occupancyTarget", 1.0)),
+        cohort_fill_deadline_seconds=float(spec.get("cohortFillDeadlineSeconds", 2.0)),
     )
 
 

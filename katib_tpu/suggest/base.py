@@ -123,6 +123,15 @@ class Suggester(abc.ABC):
     #: registry key, e.g. "random"
     name: str = ""
 
+    #: whether proposals depend on observed results.  The async suggest
+    #: loop keeps a deep proposal lookahead for NON-adaptive suggesters
+    #: (random/grid/sobol enumerate the same points regardless of history)
+    #: but clamps it to the in-flight width for adaptive ones — racing an
+    #: ASHA/BO/PBT suggester far ahead of its observations burns the trial
+    #: budget on uninformed proposals (e.g. rung-0 randoms that crowd out
+    #: promotions).  Conservative default: adaptive.
+    adaptive: bool = True
+
     def __init__(self, spec: ExperimentSpec):
         self.spec = spec
         self.validate(spec)

@@ -20,6 +20,8 @@ from katib_tpu.suggest.base import SearchExhausted, Suggester, SuggesterError, r
 
 @register("grid")
 class GridSuggester(Suggester):
+    adaptive = False  # fixed enumeration, safe to propose far ahead
+
     @classmethod
     def validate(cls, spec: ExperimentSpec) -> None:
         import math

@@ -11,6 +11,8 @@ from katib_tpu.suggest.space import SpaceEncoder
 
 @register("random")
 class RandomSuggester(Suggester):
+    adaptive = False  # history offsets the stream but never shapes points
+
     def get_suggestions(
         self, experiment: Experiment, count: int
     ) -> list[TrialAssignmentSet]:

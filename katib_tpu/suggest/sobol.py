@@ -12,6 +12,8 @@ from katib_tpu.suggest.space import SpaceEncoder
 
 @register("sobol")
 class SobolSuggester(Suggester):
+    adaptive = False  # low-discrepancy sequence, independent of results
+
     @classmethod
     def validate(cls, spec) -> None:
         # the scipy import itself is deferred to first use for startup

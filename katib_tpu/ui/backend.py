@@ -628,6 +628,15 @@ async function counters(){
   // step loop (window size under the scan loop, 1 under eager stepping)
   const spdM=m['katib_steps_per_dispatch'];
   const spd=spdM&&spdM.samples.length?spdM.samples[0].value:null;
+  // async-orchestrator strip: mesh occupancy (busy slot fraction; sustained
+  // < 0.5 means the mesh idles between cohorts), the suggest->schedule
+  // queue depth, and mean suggester latency from the suggest loop
+  const occM=m['katib_mesh_occupancy'];
+  const occ=occM&&occM.samples.length?occM.samples[0].value:null;
+  const pendM=m['katib_pending_proposals'];
+  const pend=pendM&&pendM.samples.length?pendM.samples[0].value:null;
+  const sugM=m['katib_suggest_seconds'];
+  const sug=sugM&&sugM.total?(sugM.samples.reduce((a,x)=>a+x.sum,0)/sugM.total):null;
   document.getElementById('counters').innerHTML=
     `<small>trials: ${tot('katib_trial_created_total')} created · `+
     `${tot('katib_trial_succeeded_total')} succeeded · `+
@@ -651,6 +660,9 @@ async function counters(){
     (tot('katib_suggester_fence_rebuilds_total')?` · fence rebuilds: ${tot('katib_suggester_fence_rebuilds_total')}`:'')+
     (tot('katib_fsck_repairs_total')?` · fsck repairs: ${tot('katib_fsck_repairs_total')}`:'')+
     (spd!==null?` · steps/dispatch: ${spd.toFixed(1)}${spd<=1?' <b>EAGER</b>':''}`:'')+
+    (occ!==null?` · occupancy: ${occ.toFixed(2)}${occ<0.5?' <b>MESH IDLE</b>':''}`:'')+
+    (pend!==null?` · pending proposals: ${pend.toFixed(0)}`:'')+
+    (sug!==null?` · suggest: ${sug.toFixed(3)}s`:'')+
     (mean!==null?` · mean trial ${mean.toFixed(1)}s`:'')+'</small>';
 }
 async function refresh(){

@@ -418,6 +418,24 @@ steps_per_dispatch = REGISTRY.gauge(
     "stepping — the first thing to check when MFU is low)",
 )
 
+# -- async orchestration (orchestrator/async_loops.py) ------------------------
+
+suggest_seconds = REGISTRY.histogram(
+    "katib_suggest_seconds",
+    "Wall-clock latency of each suggest-loop suggester call (async "
+    "orchestrator; hidden behind training when lookahead is healthy)",
+)
+pending_proposals = REGISTRY.gauge(
+    "katib_pending_proposals",
+    "Proposed-but-undispatched trials held in the suggest->schedule queue "
+    "(0 sustained means the suggester cannot keep up with the mesh)",
+)
+mesh_occupancy = REGISTRY.gauge(
+    "katib_mesh_occupancy",
+    "Fraction of executor slots busy with dispatched trials "
+    "(sustained < 0.5 means the mesh idles between cohorts)",
+)
+
 # -- vectorized trial cohorts (runner/cohort.py) ------------------------------
 
 cohorts_executed = REGISTRY.counter(

@@ -1,0 +1,547 @@
+"""Async orchestrator (orchestrator/async_loops.py): decoupled
+suggest/schedule/harvest loops, heterogeneous cohort packing, occupancy
+backpressure, and the crash/drain invariants the sync loop already holds.
+
+The equivalence tests use the GRID suggester deliberately: its enumeration
+is independent of how proposals are batched, so sync and async runs must
+produce bit-identical (params, objective) multisets.  Random search is NOT
+split-invariant (its stream is offset by ``len(experiment.trials)`` at call
+time), so it can only be compared statistically, not exactly.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from katib_tpu.core.types import (
+    AlgorithmSpec,
+    ExperimentCondition,
+    ExperimentSpec,
+    FeasibleSpace,
+    ObjectiveSpec,
+    ObjectiveType,
+    ParameterSpec,
+    ParameterType,
+    ResumePolicy,
+    TrialCondition,
+)
+from katib_tpu.core.validation import ValidationError, validate_experiment
+from katib_tpu.orchestrator import Orchestrator
+from katib_tpu.orchestrator import journal as jr
+from katib_tpu.orchestrator.async_loops import AsyncLoops, OccupancyMeter
+from katib_tpu.runner.cohort import attach_cohort_fn
+from katib_tpu.suggest.base import Suggester, make_suggester
+
+OBJ = ObjectiveSpec(type=ObjectiveType.MAXIMIZE, objective_metric_name="accuracy")
+
+
+def quadratic_trainer(ctx):
+    x = float(ctx.params["x"])
+    ctx.report(step=1, accuracy=1.0 - 0.01 * (x - 2.0) ** 2)
+
+
+def make_spec(**kw):
+    defaults = dict(
+        name=kw.pop("name", f"async-exp-{time.time_ns()}"),
+        objective=OBJ,
+        algorithm=AlgorithmSpec(name="random", settings={"seed": "7"}),
+        parameters=[
+            ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min=-4.0, max=4.0)),
+        ],
+        train_fn=quadratic_trainer,
+        parallel_trial_count=4,
+        max_trial_count=8,
+    )
+    defaults.update(kw)
+    return ExperimentSpec(**defaults)
+
+
+def grid_spec(points=12, **kw):
+    """Finite 1-D grid: enumeration order is batch-split independent."""
+    kw.setdefault("algorithm", AlgorithmSpec(name="grid"))
+    kw.setdefault(
+        "parameters",
+        [
+            ParameterSpec(
+                "x",
+                ParameterType.DOUBLE,
+                FeasibleSpace(min=0.0, max=float(points - 1), step=1.0),
+            )
+        ],
+    )
+    kw.setdefault("max_trial_count", points)
+    return make_spec(**kw)
+
+
+class DelaySuggester(Suggester):
+    """Wraps the real suggester with a fixed per-call latency — the
+    'slow suggester' the lookahead exists to hide."""
+
+    name = "delay"
+
+    def __init__(self, inner: Suggester, delay: float):
+        self.inner = inner
+        self.delay = delay
+        self.calls = 0
+        self.adaptive = inner.adaptive
+        self.spec = inner.spec
+
+    def get_suggestions(self, experiment, count):
+        self.calls += 1
+        time.sleep(self.delay)
+        return self.inner.get_suggestions(experiment, count)
+
+
+def outcome_set(exp):
+    """The multiset equivalence key: sorted (params, objective) pairs."""
+    out = []
+    for t in exp.trials.values():
+        obj = None
+        if t.observation is not None:
+            obj = {m.name: m.value for m in t.observation.metrics}.get("accuracy")
+        out.append((tuple(sorted((k, v) for k, v in t.params().items())), obj))
+    return sorted(out, key=repr)
+
+
+# ---------------------------------------------------------------------------
+# engine plumbing
+# ---------------------------------------------------------------------------
+
+
+class TestSpecSurface:
+    def test_new_fields_validate(self):
+        spec = make_spec(
+            suggest_lookahead=8,
+            occupancy_target=0.5,
+            cohort_fill_deadline_seconds=0.1,
+            async_orch=True,
+        )
+        validate_experiment(spec)
+
+    @pytest.mark.parametrize(
+        "kw",
+        [
+            dict(suggest_lookahead=0),
+            dict(occupancy_target=0.0),
+            dict(occupancy_target=1.5),
+            dict(cohort_fill_deadline_seconds=-1.0),
+        ],
+    )
+    def test_bad_fields_rejected(self, kw):
+        with pytest.raises(ValidationError):
+            validate_experiment(make_spec(**kw))
+
+    def test_yaml_round_trip(self):
+        from katib_tpu.sdk.yaml_spec import experiment_spec_from_dict
+
+        spec = experiment_spec_from_dict(
+            {
+                "name": "y",
+                "objective": {"type": "maximize", "objectiveMetricName": "accuracy"},
+                "algorithm": {"algorithmName": "random"},
+                "parameters": [
+                    {
+                        "name": "x",
+                        "parameterType": "double",
+                        "feasibleSpace": {"min": "0", "max": "1"},
+                    }
+                ],
+                "trialTemplate": {"trainFn": "tests.test_async_orchestrator.quadratic_trainer"},
+                "suggestLookahead": 6,
+                "occupancyTarget": 0.75,
+                "cohortFillDeadlineSeconds": 0.25,
+                "asyncOrch": False,
+            }
+        )
+        assert spec.suggest_lookahead == 6
+        assert spec.occupancy_target == 0.75
+        assert spec.cohort_fill_deadline_seconds == 0.25
+        assert spec.async_orch is False
+
+    def test_queued_event_registered(self):
+        assert "queued" in jr.EVENTS
+
+    def test_escape_hatch_env(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("KATIB_ASYNC_ORCH", "0")
+        orch = Orchestrator(workdir=str(tmp_path))
+        exp = orch.run(make_spec(max_trial_count=4))
+        assert exp.condition is ExperimentCondition.MAX_TRIALS_REACHED
+        assert orch.async_stats is None  # sync loop ran
+
+    def test_spec_flag_beats_env(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("KATIB_ASYNC_ORCH", "0")
+        orch = Orchestrator(workdir=str(tmp_path))
+        exp = orch.run(make_spec(max_trial_count=4, async_orch=True))
+        assert exp.condition is ExperimentCondition.MAX_TRIALS_REACHED
+        assert orch.async_stats is not None
+
+    def test_async_default_on(self, tmp_path):
+        orch = Orchestrator(workdir=str(tmp_path))
+        exp = orch.run(make_spec(max_trial_count=4))
+        assert exp.condition is ExperimentCondition.MAX_TRIALS_REACHED
+        assert orch.async_stats is not None
+        assert orch.async_stats["trials_settled"] == 4
+
+
+class TestOccupancyMeter:
+    def test_clock_starts_at_first_dispatch(self):
+        m = OccupancyMeter(4)
+        m.update(0)  # cold ramp: ignored
+        assert m.elapsed() == 0.0
+        m.update(4)
+        time.sleep(0.05)
+        m.update(4)
+        assert m.elapsed() > 0
+        assert m.sustained() == pytest.approx(1.0)
+
+    def test_half_busy_integrates_to_half(self):
+        m = OccupancyMeter(4)
+        m.update(2)
+        time.sleep(0.05)
+        m.update(2)
+        assert m.sustained() == pytest.approx(0.5, abs=0.01)
+
+
+# ---------------------------------------------------------------------------
+# heterogeneous cohort packing
+# ---------------------------------------------------------------------------
+
+
+def _cohort_pair(sizes, lock):
+    """train_fn/cohort twin that records dispatched cohort sizes."""
+
+    def train_fn(ctx):
+        with lock:
+            sizes.append(1)
+        ctx.report(step=1, accuracy=1.0)
+
+    def cohort_fn(cctx):
+        with lock:
+            sizes.append(len(cctx.members))
+        cctx.report(step=1, accuracy=[1.0] * len(cctx))
+
+    return attach_cohort_fn(train_fn, cohort_fn)
+
+
+class TestCohortPacking:
+    def test_ragged_remainder_flushes_instead_of_waiting(self, tmp_path):
+        """10 trials at width 4 -> 4+4+2: the final partial bucket flushes
+        on the budget-starvation/deadline path instead of stalling the
+        experiment forever (the bug cohortFillDeadlineSeconds fixes)."""
+        sizes, lock = [], threading.Lock()
+        spec = make_spec(
+            train_fn=_cohort_pair(sizes, lock),
+            cohort_width=4,
+            cohort_key="pack",
+            parallel_trial_count=4,
+            max_trial_count=10,
+            cohort_fill_deadline_seconds=0.2,
+        )
+        t0 = time.time()
+        exp = Orchestrator(workdir=str(tmp_path)).run(spec)
+        assert exp.condition is ExperimentCondition.MAX_TRIALS_REACHED
+        assert time.time() - t0 < 30, "partial bucket stalled the run"
+        assert len(exp.trials) == 10
+        assert all(
+            t.condition is TrialCondition.SUCCEEDED for t in exp.trials.values()
+        )
+        assert sum(sizes) == 10
+        assert max(sizes) <= 4
+        assert any(s > 1 for s in sizes), f"no cohorts packed: {sizes}"
+
+    def test_fill_deadline_flushes_partial_bucket(self, tmp_path):
+        """A suggester that trickles one proposal per call still makes
+        progress: the deadline flushes undersized buckets."""
+        sizes, lock = [], threading.Lock()
+
+        class Trickle(Suggester):
+            name = "trickle"
+            adaptive = False
+
+            def get_suggestions(self, experiment, count):
+                from katib_tpu.core.types import (
+                    ParameterAssignment,
+                    TrialAssignmentSet,
+                )
+
+                time.sleep(0.05)
+                return [
+                    TrialAssignmentSet(
+                        assignments=[
+                            ParameterAssignment("x", float(len(experiment.trials)))
+                        ]
+                    )
+                ]
+
+        spec = make_spec(
+            train_fn=_cohort_pair(sizes, lock),
+            cohort_width=4,
+            cohort_key="pack",
+            parallel_trial_count=4,
+            max_trial_count=4,
+            cohort_fill_deadline_seconds=0.05,
+            suggest_lookahead=1,
+        )
+        orch = Orchestrator(workdir=str(tmp_path))
+        orig = make_suggester
+
+        import katib_tpu.orchestrator.orchestrator as orch_mod
+
+        try:
+            orch_mod.make_suggester = lambda s: Trickle(s)
+            exp = orch.run(spec)
+        finally:
+            orch_mod.make_suggester = orig
+        assert exp.condition is ExperimentCondition.MAX_TRIALS_REACHED
+        assert sum(sizes) == 4
+        # with one proposal per 50ms and a 50ms deadline, at least one
+        # bucket must have flushed below full width
+        assert min(sizes) < 4, f"deadline never flushed a partial bucket: {sizes}"
+
+    def test_keyless_trials_stay_singletons(self, tmp_path):
+        sizes, lock = [], threading.Lock()
+        spec = make_spec(
+            train_fn=_cohort_pair(sizes, lock),
+            cohort_width=4,  # width set but NO cohort_key and no labels
+            parallel_trial_count=4,
+            max_trial_count=6,
+        )
+        exp = Orchestrator(workdir=str(tmp_path)).run(spec)
+        assert exp.condition is ExperimentCondition.MAX_TRIALS_REACHED
+        assert sizes and max(sizes) == 1
+
+
+# ---------------------------------------------------------------------------
+# lookahead + backpressure
+# ---------------------------------------------------------------------------
+
+
+class TestLookaheadAndBackpressure:
+    def test_slow_suggester_latency_is_hidden(self, tmp_path):
+        """16 trials x 0.1s on 4 slots = 0.4s of training floor; a 0.1s
+        suggester adds ~0.4s+ to the SYNC critical path (serialized calls)
+        but almost nothing to the async one (calls overlap training)."""
+
+        def sleeper(ctx):
+            time.sleep(0.1)
+            ctx.report(step=1, accuracy=1.0)
+
+        import katib_tpu.orchestrator.orchestrator as orch_mod
+
+        orig = make_suggester
+        elapsed = {}
+        try:
+            orch_mod.make_suggester = lambda s: DelaySuggester(orig(s), 0.1)
+            for label, async_flag in (("sync", False), ("async", True)):
+                spec = make_spec(
+                    train_fn=sleeper,
+                    parallel_trial_count=4,
+                    max_trial_count=16,
+                    async_orch=async_flag,
+                )
+                t0 = time.perf_counter()
+                orch = Orchestrator(workdir=str(tmp_path / label))
+                exp = orch.run(spec)
+                elapsed[label] = time.perf_counter() - t0
+                assert exp.condition is ExperimentCondition.MAX_TRIALS_REACHED
+                assert len(exp.trials) == 16
+                if async_flag:
+                    stats = orch.async_stats
+        finally:
+            orch_mod.make_suggester = orig
+        assert elapsed["async"] < elapsed["sync"], elapsed
+        # training floor is 0.4s; the async run should not pay much more
+        # than one suggester delay on top of it
+        assert stats["sustained_occupancy"] > 0.5, stats
+
+    def test_occupancy_target_throttles_concurrency(self, tmp_path):
+        """occupancy_target=0.5 with 4 slots caps concurrent member trials
+        at 2 even though the pool has 4 workers."""
+        peak, cur, lock = [0], [0], threading.Lock()
+
+        def tracker(ctx):
+            with lock:
+                cur[0] += 1
+                peak[0] = max(peak[0], cur[0])
+            time.sleep(0.05)
+            with lock:
+                cur[0] -= 1
+            ctx.report(step=1, accuracy=1.0)
+
+        spec = make_spec(
+            train_fn=tracker,
+            parallel_trial_count=4,
+            occupancy_target=0.5,
+            max_trial_count=8,
+        )
+        exp = Orchestrator(workdir=str(tmp_path)).run(spec)
+        assert exp.condition is ExperimentCondition.MAX_TRIALS_REACHED
+        assert peak[0] <= 2, f"throttle leaked: {peak[0]} concurrent trials"
+
+    def test_parallel_trial_count_still_caps_members(self, tmp_path):
+        """Default occupancy_target=1.0 preserves the sync concurrency
+        contract: never more than parallel_trial_count members at once."""
+        peak, cur, lock = [0], [0], threading.Lock()
+
+        def tracker(ctx):
+            with lock:
+                cur[0] += 1
+                peak[0] = max(peak[0], cur[0])
+            time.sleep(0.03)
+            with lock:
+                cur[0] -= 1
+            ctx.report(step=1, accuracy=1.0)
+
+        spec = make_spec(train_fn=tracker, parallel_trial_count=3, max_trial_count=9)
+        exp = Orchestrator(workdir=str(tmp_path)).run(spec)
+        assert exp.condition is ExperimentCondition.MAX_TRIALS_REACHED
+        assert peak[0] <= 3, f"{peak[0]} members ran concurrently"
+
+    def test_metrics_published(self, tmp_path):
+        from katib_tpu.utils import observability as obs
+
+        before = obs.suggest_seconds.snapshot()["total"]
+        orch = Orchestrator(workdir=str(tmp_path))
+        orch.run(make_spec(max_trial_count=4))
+        assert obs.suggest_seconds.snapshot()["total"] > before
+        # gauges exist and were reset at wind-down
+        assert obs.mesh_occupancy.snapshot()["samples"][0]["value"] == 0.0
+        assert obs.pending_proposals.snapshot()["samples"][0]["value"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# sync/async equivalence (grid: batch-split independent)
+# ---------------------------------------------------------------------------
+
+
+class TestEquivalence:
+    def test_grid_outcomes_bit_identical(self, tmp_path):
+        runs = {}
+        for label, async_flag in (("sync", False), ("async", True)):
+            spec = grid_spec(points=12, async_orch=async_flag)
+            exp = Orchestrator(workdir=str(tmp_path / label)).run(spec)
+            assert exp.condition in (
+                ExperimentCondition.MAX_TRIALS_REACHED,
+                ExperimentCondition.SUCCEEDED,
+            )
+            runs[label] = outcome_set(exp)
+        assert runs["sync"] == runs["async"]
+        assert len(runs["async"]) == 12
+
+
+# ---------------------------------------------------------------------------
+# drain + crash/resume: exactly-once across the queue hand-offs
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+class TestDrainAndCrash:
+    def test_drain_mid_queue_resumes_without_loss_or_dup(self, tmp_path):
+        """Drain while trials sit in every stage (running / ready queue):
+        resume completes all of them, none lost, none duplicated."""
+        gate_open = threading.Event()
+        release = threading.Event()
+
+        def trainer(ctx):
+            gate_open.set()
+            while not release.is_set() and not ctx.should_stop():
+                time.sleep(0.005)
+            ctx.report(step=1, accuracy=float(ctx.params["x"]))
+
+        spec = grid_spec(
+            points=8,
+            name="drain-queue",
+            train_fn=trainer,
+            parallel_trial_count=2,
+            resume_policy=ResumePolicy.LONG_RUNNING,
+            drain_grace_seconds=5.0,
+            suggest_lookahead=8,  # force a deep ready queue at drain time
+        )
+        orch = Orchestrator(workdir=str(tmp_path))
+        runner = threading.Thread(target=lambda: orch.run(spec))
+        runner.start()
+        assert gate_open.wait(timeout=30)
+        time.sleep(0.3)  # let the suggest loop fill the lookahead
+        orch.drain()
+        runner.join(timeout=30)
+        assert not runner.is_alive()
+        assert orch.drained
+
+        release.set()
+        orch2 = Orchestrator(workdir=str(tmp_path))
+        exp2 = orch2.run(spec, experiment=orch2.load_experiment(spec))
+        assert exp2.condition in (
+            ExperimentCondition.MAX_TRIALS_REACHED,
+            ExperimentCondition.SUCCEEDED,
+        )
+        assert len(exp2.trials) == 8, "trials lost or duplicated across drain"
+        assert all(
+            t.condition is TrialCondition.SUCCEEDED for t in exp2.trials.values()
+        )
+        # every grid point ran exactly once
+        xs = sorted(float(t.params()["x"]) for t in exp2.trials.values())
+        assert xs == [float(i) for i in range(8)]
+
+    def test_crash_mid_queue_resumes_exactly_once(self, tmp_path):
+        """Hard-kill the process at a journal append while proposals sit in
+        the suggest->schedule queue, then resume: the journal restores the
+        in-flight state and settles every trial exactly once."""
+        import subprocess
+        import sys
+        import textwrap
+
+        from katib_tpu.utils import faults
+
+        workdir = tmp_path / "wd"
+        child = textwrap.dedent(
+            """
+            import sys
+            sys.path[:0] = {syspath!r}
+            from tests.test_async_orchestrator import grid_spec
+            from katib_tpu.orchestrator import Orchestrator
+            from katib_tpu.core.types import ResumePolicy
+            spec = grid_spec(points=6, name="crash-queue",
+                             parallel_trial_count=2, suggest_lookahead=6,
+                             resume_policy=ResumePolicy.LONG_RUNNING)
+            Orchestrator(workdir={workdir!r}).run(spec)
+            """
+        ).format(syspath=[p for p in sys.path if p], workdir=str(workdir))
+        env = dict(os.environ)
+        # die on a mid-experiment journal append: by then proposals are
+        # queued, some trials started, none of the later ones settled
+        env[faults.CRASH_AT_ENV] = "journal.append:8"
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env.pop("KATIB_ASYNC_ORCH", None)
+        proc = subprocess.run(
+            [sys.executable, "-c", child],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert proc.returncode == 137, proc.stderr[-2000:]
+
+        spec = grid_spec(
+            points=6,
+            name="crash-queue",
+            parallel_trial_count=2,
+            suggest_lookahead=6,
+            resume_policy=ResumePolicy.LONG_RUNNING,
+        )
+        orch = Orchestrator(workdir=str(workdir))
+        exp = orch.run(spec, resume=True)
+        assert exp.condition in (
+            ExperimentCondition.MAX_TRIALS_REACHED,
+            ExperimentCondition.SUCCEEDED,
+        )
+        assert len(exp.trials) == 6, "crash lost or duplicated queued trials"
+        assert all(
+            t.condition is TrialCondition.SUCCEEDED for t in exp.trials.values()
+        )
+        xs = sorted(float(t.params()["x"]) for t in exp.trials.values())
+        assert xs == [float(i) for i in range(6)]
+        # the replayed journal holds no duplicate settlements
+        _, stats = jr.replay_journal(str(workdir), "crash-queue")
+        assert stats.duplicates == 0

@@ -345,7 +345,11 @@ class TestMedianStopIntegration:
 
         spec = make_spec(
             train_fn=trainer,
-            algorithm=AlgorithmSpec(name="random", settings={"random_state": "3"}),
+            # pinned seed: random draws depend on batch split, which
+            # differs between the sync and async engines; this seed's
+            # good/bad mix reaches a good-majority median early enough
+            # to stop bad trials under BOTH engines' proposal orders
+            algorithm=AlgorithmSpec(name="random", settings={"random_state": "5"}),
             parameters=[
                 ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min=-1.0, max=1.0)),
             ],
@@ -463,6 +467,10 @@ class TestExecutionRegressions:
             objective=ObjectiveSpec(
                 type=ObjectiveType.MAXIMIZE, objective_metric_name="accuracy", goal=0.9
             ),
+            # pinned seed: the first dispatch batch must contain an x>0
+            # point, or all 4 slots legitimately run their 10s loops before
+            # the goal trial can exist and the time bound below flakes
+            algorithm=AlgorithmSpec(name="random", settings={"seed": "0"}),
             parameters=[
                 ParameterSpec("x", ParameterType.DOUBLE, FeasibleSpace(min=-1.0, max=1.0)),
             ],
