@@ -1104,6 +1104,10 @@ def _async_occupancy_child() -> None:
         if orch.async_stats is not None:
             block["sustained_occupancy"] = orch.async_stats["sustained_occupancy"]
             block["lookahead"] = orch.async_stats["lookahead"]
+            # supervision summary: a benched run that silently burned loop
+            # restarts (or fell back to sync) is not a clean measurement
+            block["loop_restarts"] = orch.async_stats["loop_restarts"]
+            block["fallback"] = orch.async_stats["fallback"]
         return block
 
     sync = sweep("sync")

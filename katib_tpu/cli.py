@@ -750,6 +750,14 @@ def cmd_chaos(args: argparse.Namespace) -> int:
             print("--crash-at and --kill-at are mutually exclusive", file=sys.stderr)
             return 2
         return _chaos_crash(args)
+    if getattr(args, "soak", None):
+        from katib_tpu.orchestrator.soak import run_soak
+
+        # soak rounds want enough trials per round for occupancy and
+        # mid-run kills to mean something; --trials can only raise it
+        return run_soak(
+            seconds=args.soak, seed=args.seed, trials=max(args.trials, 10)
+        )
     import tempfile
 
     from katib_tpu.core.types import (
@@ -797,6 +805,27 @@ def cmd_chaos(args: argparse.Namespace) -> int:
     wedge_devices = [int(d) for d in (args.wedge_device or [])]
     for d in wedge_devices:
         injector.wedge_device(d)
+    killed_loops = []
+    for spec_str in args.kill_loop or []:
+        parts = spec_str.split(":")
+        if parts[0] not in ("suggest", "schedule", "harvest") or len(parts) > 2:
+            print(f"bad --kill-loop {spec_str!r} (want LOOP[:N])", file=sys.stderr)
+            return 2
+        injector.kill_loop(parts[0], int(parts[1]) if len(parts) == 2 else 1)
+        killed_loops.append(parts[0])
+    stall_calls = []
+    for spec_str in args.stall_suggester or []:
+        parts = spec_str.split(":")
+        if len(parts) not in (1, 2):
+            print(
+                f"bad --stall-suggester {spec_str!r} (want SECONDS[:CALL])",
+                file=sys.stderr,
+            )
+            return 2
+        injector.stall_suggester(
+            float(parts[0]), int(parts[1]) if len(parts) == 2 else 1
+        )
+        stall_calls.append(float(parts[0]))
     injected_any = (
         args.fail_trial
         or args.fail_suggester
@@ -804,6 +833,8 @@ def cmd_chaos(args: argparse.Namespace) -> int:
         or args.hang_trial
         or args.compile_hang
         or wedge_devices
+        or killed_loops
+        or stall_calls
         or args.preempt_at is not None
     )
     if not injector.log and not injected_any:
@@ -913,6 +944,14 @@ def cmd_chaos(args: argparse.Namespace) -> int:
             args.compile_deadline if args.compile_hang else None
         ),
         drain_grace_seconds=args.drain_grace,
+        # loop-kill / suggester-stall scenarios exercise the async engine's
+        # supervisor: force the async path on (env opt-out would silently
+        # skip the seams) and tighten the stall deadline so a stalled
+        # suggester call is abandoned within the run, not after 60s
+        async_orch=(True if (killed_loops or stall_calls) else None),
+        loop_stall_deadline_seconds=(
+            args.loop_stall_deadline if (killed_loops or stall_calls) else 60.0
+        ),
         # the preempt scenario spans two orchestrator lifetimes; a resumable
         # policy upgrades the store to the durable sqlite backend so metrics
         # reported before the SIGTERM survive into the resumed process
@@ -1070,6 +1109,28 @@ def cmd_chaos(args: argparse.Namespace) -> int:
             ]
             if leftover:
                 failures.append(f"drained trials never resubmitted: {leftover}")
+    if killed_loops:
+        st = orch.async_stats or {}
+        fired = {e.get("loop") for e in injector.log if e.get("seam") == "kill-loop"}
+        for loop in killed_loops:
+            if loop not in fired:
+                failures.append(f"injected kill for the {loop!r} loop never fired")
+            elif (st.get("loop_restarts") or {}).get(loop, 0) < 1:
+                failures.append(
+                    f"killed {loop!r} loop was never restarted by the supervisor"
+                )
+        if st.get("fallback"):
+            failures.append(f"async engine fell back to sync: {st['fallback']}")
+    if stall_calls:
+        if not any(e.get("seam") == "suggester-stall" for e in injector.log):
+            failures.append("injected suggester stall never fired")
+        elif any(s > args.loop_stall_deadline for s in stall_calls) and (
+            obs.suggester_errors.get(algorithm="random") - errors_before <= 0
+        ):
+            failures.append(
+                "over-deadline suggester stall was not abandoned "
+                "(deadline-bounded call should have tripped the breaker)"
+            )
     if not exp.condition.is_terminal():
         failures.append(f"experiment not terminal: {exp.condition.value}")
     if exp.condition is ExperimentCondition.FAILED:
@@ -1526,6 +1587,43 @@ def main(argv: list[str] | None = None) -> int:
         default=None,
         help="like --crash-at but the child dies by SIGKILL "
         "(indistinguishable from the OOM killer)",
+    )
+    p.add_argument(
+        "--kill-loop",
+        action="append",
+        metavar="LOOP[:N]",
+        help="kill the named async engine loop (suggest|schedule|harvest) at "
+        "its N-th (default 1st) iteration; the supervisor must classify "
+        "the dead thread and restart it without losing or double-settling "
+        "any trial; repeatable",
+    )
+    p.add_argument(
+        "--stall-suggester",
+        action="append",
+        metavar="SECONDS[:CALL]",
+        help="wedge the CALL-th (default 1st) get_suggestions call for "
+        "SECONDS; past --loop-stall-deadline the deadline-bounded call is "
+        "abandoned and the circuit breaker absorbs it instead of freezing "
+        "the suggest loop; repeatable",
+    )
+    p.add_argument(
+        "--loop-stall-deadline",
+        type=float,
+        default=1.0,
+        metavar="SECONDS",
+        help="loopStallDeadlineSeconds used when --kill-loop or "
+        "--stall-suggester is given",
+    )
+    p.add_argument(
+        "--soak",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="seeded chaos soak: run scripted fault rounds (loop kills, "
+        "suggester stalls, trial faults, speculation) for ~SECONDS, "
+        "asserting zero lost/duplicated settlements, restart budgets "
+        "respected, and post-fault occupancy recovery; deterministic "
+        "per --seed",
     )
     p.set_defaults(fn=cmd_chaos)
 

@@ -727,6 +727,21 @@ class ExperimentSpec:
     # when the remaining max_trial_count budget can never fill it) instead
     # of waiting indefinitely for a full-width group.
     cohort_fill_deadline_seconds: float = 2.0
+    # Loop supervision (orchestrator/supervisor.py): a live async loop whose
+    # progress watermark has not advanced for this long — while upstream work
+    # was available — is classified STALLED and restarted from journal state.
+    loop_stall_deadline_seconds: float = 60.0
+    # Per-loop restart budget: after this many restarts of any single loop
+    # the supervisor stops healing and degrades to the synchronous path
+    # (KATIB_ASYNC_ORCH=0 semantics) instead of dying. 0 = never restart,
+    # fall back on the first crash/stall.
+    loop_restart_budget: int = 3
+    # Speculative straggler re-dispatch: when a member runs past
+    # straggler_factor x the median settle time it is re-submitted as a
+    # singleton; first settle wins (exactly-once journal keying), the rival
+    # is cancelled/ignored. Off by default — it burns a slot per straggler.
+    speculative_redispatch: bool = False
+    straggler_factor: float = 4.0
 
     def parameter(self, name: str) -> ParameterSpec:
         for p in self.parameters:

@@ -141,6 +141,12 @@ def validate_experiment(spec: ExperimentSpec) -> None:
         errors.append("occupancy_target must be in (0, 1]")
     if spec.cohort_fill_deadline_seconds < 0:
         errors.append("cohort_fill_deadline_seconds must be >= 0")
+    if spec.loop_stall_deadline_seconds <= 0:
+        errors.append("loop_stall_deadline_seconds must be > 0")
+    if spec.loop_restart_budget < 0:
+        errors.append("loop_restart_budget must be >= 0")
+    if spec.straggler_factor <= 1.0:
+        errors.append("straggler_factor must be > 1")
     if spec.cohort_width > 1 and spec.command is not None:
         # cohorts vectorize a white-box JAX program; a subprocess argv has
         # no train step to vmap

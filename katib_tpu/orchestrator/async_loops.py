@@ -19,9 +19,28 @@ short of members, or harvest is settling.  This engine splits the loop:
   waits indefinitely.  Dispatch backpressure is driven by slot occupancy
   (``occupancy_target``) rather than a fixed trial count, and each flushed
   bucket's compile signature feeds the prewarm worker before submit.
-- **harvest loop** (the caller's thread): settles completions through the
-  exactly-once journal path (``Orchestrator._harvest``) and owns terminal
-  verdicts, stop, drain, and the livelock guard.
+- **harvest loop** (thread): settles completions through the exactly-once
+  journal path (``Orchestrator._harvest``) and owns terminal verdicts,
+  stop, drain, and the livelock guard.
+
+The caller's thread runs the :class:`~katib_tpu.orchestrator.supervisor.
+LoopSupervisor` tick loop: all three loops are heartbeated via progress
+watermarks, classified (OK / STALLED / STARVED / CRASHED / DONE), and
+crashed or stalled loops are respawned at ``generation+1`` with frontier
+state re-seeded from the journal-backed trial map (``_reseed_lost``) under
+a bounded per-loop restart budget; stale-generation threads are fenced out
+of shared state by generation checks at every iteration and hand-off.
+After the budget is exhausted ``run()`` returns ``None`` and
+``Orchestrator.run`` degrades to the synchronous loop instead of dying.
+
+With ``speculativeRedispatch`` on, the harvest loop also re-dispatches a
+straggling member (running past ``stragglerFactor`` x the median settle
+time) as a singleton rival on a free slot: the rival executes a *clone* of
+the Trial, and first-settle-wins is enforced by object identity — the
+winner's object is (or becomes) ``exp.trials[name]``, the loser's eventual
+result hits ``Orchestrator._harvest``'s stale-owner guard and is
+discarded, so the (trial, attempt-epoch) journal keying never sees a
+second settle.
 
 The event journal is the coordination substrate: ``proposed`` (suggest),
 ``queued`` (entered a packing bucket), ``started`` (dispatched) and the
@@ -48,6 +67,8 @@ the mesh critical path is untouched.
 from __future__ import annotations
 
 import collections
+import copy
+import statistics
 import threading
 import time
 import traceback
@@ -62,6 +83,7 @@ from katib_tpu.core.types import (
 from katib_tpu.runner.cohort import cohort_fn_of
 from katib_tpu.suggest.base import call_suggester
 from katib_tpu.utils import observability as obs
+from katib_tpu.utils import tracing
 
 #: how long the wind-down waits for the suggest/schedule threads to notice
 #: the halt flag (a suggester blocked mid-call is abandoned on its daemon
@@ -153,12 +175,32 @@ class AsyncLoops:
         #: flushed units awaiting a free slot (schedule -> pool hand-off)
         self._dispatchq: collections.deque[list[Trial]] = collections.deque()
 
-        self._halt = threading.Event()       # internal: stop both loops
+        self._halt = threading.Event()       # internal: stop all three loops
         self._exhausted = threading.Event()  # suggester returned exhausted
         self._suggest_inflight = False       # a get_suggestions call is running
         self._suggester_busy = False         # erroring / cooling down, not idle
-        self._errors: list[str] = []
         self._last_activity = time.monotonic()
+        #: terminal/drained result hand-off from the harvest thread to the
+        #: supervising caller thread
+        self._result: Experiment | None = None
+        self._done = threading.Event()
+        #: first-finalizer-wins guard: a restarted-over stale harvest thread
+        #: waking up mid-wind-down must not run _terminal/_drain twice
+        self._finalize_once = threading.Lock()
+        self._finalized = False
+        self._supervisor = None  # LoopSupervisor, built in run()
+        self._fallback_reason: str | None = None
+        #: last crash traceback per loop, for the journal's supervisor events
+        self._loop_errors: dict[str, str] = {}
+        # -- speculative straggler re-dispatch bookkeeping --------------------
+        #: future -> dispatch time (monotonic), for settle-duration medians
+        #: and straggler detection; guarded by _futures_lock
+        self._fut_meta: dict = {}
+        self._settle_durations: list[float] = []
+        #: rival future -> (original future, trial name, clone trial)
+        self._rivals: dict = {}
+        self._speculated: set[str] = set()  # one rival per trial per run
+        self._spec_wins = 0
         #: members dispatched since engine start (consumption-rate estimator
         #: for the suggest loop's anticipatory refill)
         self._dispatched_total = 0
@@ -198,147 +240,358 @@ class AsyncLoops:
 
     # -- entry point ---------------------------------------------------------
 
-    def run(self) -> Experiment:
-        self._threads = [
-            threading.Thread(
-                target=self._suggest_loop,
-                name=f"suggest-{self.exp.name}",
-                daemon=True,
-            ),
-            threading.Thread(
-                target=self._schedule_loop,
-                name=f"schedule-{self.exp.name}",
-                daemon=True,
-            ),
-        ]
-        for t in self._threads:
-            t.start()
+    def run(self) -> Experiment | None:
+        """Run to a terminal (or drained) experiment under supervision.
+        Returns ``None`` when the supervisor exhausted its restart budget:
+        the caller (``Orchestrator.run``) then degrades to the synchronous
+        loop — in-flight futures stay live in the shared dict, and queued
+        proposals were put back to PENDING for resubmission."""
+        from katib_tpu.orchestrator.supervisor import LoopSupervisor
+        from katib_tpu.utils.faults import Backoff
+
+        spec = self.spec
+        sup = self._supervisor = LoopSupervisor(
+            stall_deadline=spec.loop_stall_deadline_seconds,
+            restart_budget=spec.loop_restart_budget,
+            backoff=Backoff(base=0.2, factor=2.0, cap=5.0, full_jitter=True, seed=0),
+            on_restart=self._on_loop_restart,
+        )
+        done_or_halt = lambda: self._halt.is_set() or self._done.is_set()
+        sup.add(
+            "suggest",
+            self._spawner("suggest", self._suggest_loop),
+            has_work=self._suggest_has_work,
+            finished=lambda: done_or_halt() or self._exhausted.is_set(),
+        )
+        sup.add(
+            "schedule",
+            self._spawner("schedule", self._schedule_loop),
+            has_work=self._schedule_has_work,
+            finished=done_or_halt,
+        )
+        sup.add(
+            "harvest",
+            self._spawner("harvest", self._harvest_loop),
+            # the harvest loop is the engine's poll heart: it always has
+            # work (terminal checks, occupancy metering), so its silence is
+            # always a stall, never starvation
+            finished=done_or_halt,
+        )
         try:
-            return self._harvest_loop()
+            while not self._done.wait(self.orch.poll_interval):
+                sup.tick()
+                if sup.fallback:
+                    return self._fallback_to_sync(sup.fallback_reason)
+            # the harvest THREAD ran _finish/_drain_and_exit, which closed
+            # the tracer and cleared only that thread's ambient slot — the
+            # ambient tracer is thread-local, so the caller thread (the one
+            # Orchestrator.run activated it on) restores its own slot here
+            tracing.deactivate(self.orch._prev_tracer)
+            return self._result
         finally:
             self._stop_loops()
+            self._cancel_rivals()
+            # satellite guarantee: a finished/fallen-back run never reports
+            # stale occupancy or a latched stall flag on /api/status
             obs.pending_proposals.set(0.0)
+            obs.mesh_occupancy.set(0.0)
+            for name in ("suggest", "schedule", "harvest"):
+                obs.loop_stalled.set(0.0, loop=name)
+
+    # -- supervision plumbing ------------------------------------------------
+
+    def _spawner(self, name: str, body):
+        """Thread factory for the supervisor: ``spawn(gen)`` starts the loop
+        body at generation ``gen``; crashes are recorded (not raised) so the
+        supervisor sees a dead thread, classifies, and restarts it."""
+
+        def spawn(gen: int) -> threading.Thread:
+            def main():
+                try:
+                    body(gen)
+                except Exception:
+                    self._loop_errors[name] = (
+                        f"{name} loop error:\n" + traceback.format_exc(limit=20)
+                    )
+
+            t = threading.Thread(
+                target=main, name=f"{name}-{self.exp.name}-g{gen}", daemon=True
+            )
+            t.start()
+            return t
+
+        return spawn
+
+    def _current(self, name: str, gen: int) -> bool:
+        """Generation fence: a restarted-over (stale) thread must stop
+        touching shared state the moment a replacement exists."""
+        sup = self._supervisor
+        return sup is None or sup.generation(name) == gen
+
+    def _beat(self, name: str) -> None:
+        sup = self._supervisor
+        if sup is not None:
+            sup.beat(name)
+
+    def _seam(self, name: str) -> None:
+        """Chaos seam at the top of every loop iteration, OUTSIDE all
+        engine locks (so an injected kill never strands a lock)."""
+        inj = self.orch.fault_injector
+        if inj is not None:
+            inj.on_loop_iteration(name)
+
+    def _suggest_has_work(self) -> bool:
+        """Upstream-work predicate for stall-vs-starvation: the suggest
+        loop is starved (idle through no fault of its own) while the bank
+        is full, the budget is spent, the suggester is exhausted, or the
+        breaker is cooling down."""
+        if self._exhausted.is_set() or not self.breaker.allow():
+            return False
+        want = self.lookahead - self._queued_count() + self._consumed_last_call
+        if self.spec.max_trial_count is not None:
+            want = min(want, self.spec.max_trial_count - len(self.exp.trials))
+        return want > 0
+
+    def _schedule_has_work(self) -> bool:
+        """The schedule loop has work when something can actually MOVE:
+        ready trials to pack, a bucket full or past its fill deadline, or a
+        dispatchable head unit within the occupancy limit — a queue frozen
+        by backpressure or drain is starvation, not a stall."""
+        orch = self.orch
+        if (
+            orch._drain_requested.is_set()
+            or orch._stop_requested.is_set()
+            or self.stop_event.is_set()
+        ):
+            return False
+        now = time.monotonic()
+        with self._queue_lock:
+            if self._ready:
+                return True
+            for key, bucket in self._packing.items():
+                if len(bucket) >= self.width:
+                    return True
+                if (
+                    now - self._pack_ts.get(key, now)
+                    >= self.spec.cohort_fill_deadline_seconds
+                ):
+                    return True
+            if self._dispatchq:
+                head = self._dispatchq[0]
+                with self._futures_lock:
+                    undone = self._undone_members()
+                return undone == 0 or undone + len(head) <= self.member_limit
+        return False
+
+    def _on_loop_restart(self, name: str, gen: int, why: str, restarts: int) -> None:
+        """Supervisor restart callback: audit the restart in the journal and
+        re-seed any frontier state the dying loop dropped."""
+        detail = self._loop_errors.pop(name, "")
+        self.orch._jappend(
+            "supervisor",
+            self.exp,
+            extra={
+                "action": "restart",
+                "loop": name,
+                "generation": gen,
+                "why": why,
+                "restarts": restarts,
+                "error": detail[-500:] if detail else "",
+            },
+        )
+        self._reseed_lost()
+
+    def _reseed_lost(self) -> None:
+        """Rebuild the suggest->schedule frontier after a loop death: every
+        non-terminal, non-drained trial that is in no queue and owned by no
+        future goes back to the ready deque as PENDING.  ``exp.trials`` is
+        the journal-backed state (``proposed``/``queued``/``started``
+        records materialized it), so this is exactly what a process-level
+        resume would reconstruct — done in-process, without the restart."""
+        with self._state_lock, self._queue_lock, self._futures_lock:
+            held = {t.name for t in self._ready}
+            for bucket in self._packing.values():
+                held.update(t.name for t in bucket)
+            for unit in self._dispatchq:
+                held.update(t.name for t in unit)
+            for owner in self.futures.values():
+                for t in owner if isinstance(owner, list) else [owner]:
+                    held.add(t.name)
+            for _orig, name, _clone in self._rivals.values():
+                held.add(name)
+            lost = [
+                t
+                for t in self.exp.trials.values()
+                if not t.condition.is_terminal()
+                and t.condition is not TrialCondition.DRAINED
+                and t.name not in held
+            ]
+            for t in lost:
+                t.condition = TrialCondition.PENDING
+                self._ready.append(t)
+        if lost:
+            self._update_pending_gauge()
+
+    def _fallback_to_sync(self, reason: str | None) -> None:
+        """Restart budget exhausted: wind the async engine down WITHOUT
+        failing the experiment.  Queued proposals go back to PENDING (the
+        sync loop resubmits them), in-flight futures stay in the shared
+        dict (the sync loop harvests them), and ``run()`` returns None."""
+        orch, exp = self.orch, self.exp
+        self._fallback_reason = reason or "supervisor fallback"
+        # the sync loop owns the experiment from here: no surviving or
+        # stale harvest thread may reach _terminal/_drain anymore
+        with self._finalize_once:
+            self._finalized = True
+        self._stop_loops()
+        self._cancel_rivals()
+        self._reseed_lost()
+        for t in self._drain_queues():
+            t.condition = TrialCondition.PENDING
+            t.message = "async engine fell back to sync; resubmitted"
+        sup = self._supervisor
+        orch._jappend(
+            "supervisor",
+            exp,
+            extra={
+                "action": "fallback",
+                "reason": self._fallback_reason,
+                "restarts": sup.restart_counts() if sup else {},
+            },
+        )
+        self._record_stats()
+        return None
 
     # -- suggest loop --------------------------------------------------------
 
-    def _suggest_loop(self) -> None:
+    def _suggest_loop(self, gen: int = 0) -> None:
         orch, exp, spec = self.orch, self.exp, self.spec
-        try:
-            while not self._halt.is_set():
-                if self._exhausted.is_set():
-                    return
-                # anticipatory refill: a refill of exactly (lookahead -
-                # queued) arrives one suggester-latency late, by which time
-                # the scheduler has consumed ~latency*throughput more — at
-                # steady state the bank sits that much below target and the
-                # mesh starves briefly every cycle.  Adding the members
-                # consumed during the LAST call (a one-step rate estimate)
-                # keeps the bank at the full lookahead when the call lands.
-                want = (
-                    self.lookahead
-                    - self._queued_count()
-                    + self._consumed_last_call
-                )
-                if spec.max_trial_count is not None:
-                    want = min(want, spec.max_trial_count - len(exp.trials))
-                if want <= 0:
-                    self._halt.wait(orch.poll_interval)
-                    continue
-                if not self.breaker.allow():
-                    # cooling down after an error: not idle, not progress
-                    self._suggester_busy = True
-                    self._last_activity = time.monotonic()
-                    self._halt.wait(orch.poll_interval)
-                    continue
-                self._suggester_busy = False
-                sug_start = orch._tracer.elapsed() if orch._tracer else 0.0
-                t0 = time.perf_counter()
-                d0 = self._dispatched_total
-                self._suggest_inflight = True
-                try:
-                    proposals, outcome = call_suggester(
-                        self.suggester, exp, want, self.breaker, orch.fault_injector
-                    )
-                finally:
-                    self._suggest_inflight = False
-                self._consumed_last_call = self._dispatched_total - d0
-                dur = time.perf_counter() - t0
-                obs.suggestion_latency.observe(dur, algorithm=spec.algorithm.name)
-                obs.suggest_seconds.observe(dur, algorithm=spec.algorithm.name)
-                if orch._tracer is not None and (
-                    proposals or outcome in ("exhausted", "error") or dur >= 1e-3
-                ):
-                    orch._tracer.record(
-                        "suggest",
-                        sug_start,
-                        dur,
-                        algorithm=spec.algorithm.name,
-                        count=len(proposals),
-                        outcome=outcome,
-                    )
-                if outcome == "error":
-                    self._suggester_busy = True
-                    self._last_activity = time.monotonic()
-                    obs.suggester_errors.inc(algorithm=spec.algorithm.name)
-                if proposals:
-                    with self._state_lock:
-                        trials = [
-                            orch._materialize(
-                                exp,
-                                p,
-                                # rules attach at DISPATCH (_refresh_rules),
-                                # not here: a lookahead proposal materializes
-                                # long before the history its rule snapshot
-                                # would need
-                                None,
-                                self.suggester,
-                                condition=TrialCondition.PENDING,
-                                journal=False,
-                            )
-                            for p in proposals
-                        ]
-                    # one durability barrier for the whole refill — per-trial
-                    # appends would serialize ~lookahead fsyncs between the
-                    # suggester returning and the first dispatch
-                    orch._jappend_group("proposed", exp, trials)
-                    with self._queue_lock:
-                        self._ready.extend(trials)
-                    self._update_pending_gauge()
-                    with self._state_lock:
-                        orch._persist_suggester(exp, self.suggester)
-                        orch._publish(exp)
-                    self._last_activity = time.monotonic()
-                if outcome == "exhausted":
-                    # set AFTER the final proposals are queued, so the
-                    # terminal check never sees "exhausted + empty" early
-                    self._exhausted.set()
-                    return
-                if not proposals:
-                    self._halt.wait(orch.poll_interval)
-        except Exception:
-            self._errors.append(
-                "suggest loop error:\n" + traceback.format_exc(limit=20)
+        while not self._halt.is_set() and self._current("suggest", gen):
+            self._seam("suggest")
+            if self._exhausted.is_set():
+                return
+            # anticipatory refill: a refill of exactly (lookahead -
+            # queued) arrives one suggester-latency late, by which time
+            # the scheduler has consumed ~latency*throughput more — at
+            # steady state the bank sits that much below target and the
+            # mesh starves briefly every cycle.  Adding the members
+            # consumed during the LAST call (a one-step rate estimate)
+            # keeps the bank at the full lookahead when the call lands.
+            want = (
+                self.lookahead
+                - self._queued_count()
+                + self._consumed_last_call
             )
-            self._halt.set()
+            if spec.max_trial_count is not None:
+                want = min(want, spec.max_trial_count - len(exp.trials))
+            if want <= 0:
+                self._halt.wait(orch.poll_interval)
+                continue
+            if not self.breaker.allow():
+                # cooling down after an error: not idle, not progress
+                self._suggester_busy = True
+                self._last_activity = time.monotonic()
+                self._halt.wait(orch.poll_interval)
+                continue
+            self._suggester_busy = False
+            sug_start = orch._tracer.elapsed() if orch._tracer else 0.0
+            t0 = time.perf_counter()
+            d0 = self._dispatched_total
+            self._suggest_inflight = True
+            try:
+                # the deadline bounds a wedged/blocked get_suggestions:
+                # it trips the breaker (bounded retries, then a diagnosed
+                # terminal verdict) instead of freezing this loop until
+                # the supervisor burns a restart on it.  Half the stall
+                # deadline, so a call abandoned at its limit still returns
+                # (and beats) before the supervisor classifies the loop
+                # stalled — abandonment is the cheap recovery, a restart
+                # is the expensive one
+                proposals, outcome = call_suggester(
+                    self.suggester,
+                    exp,
+                    want,
+                    self.breaker,
+                    orch.fault_injector,
+                    deadline=0.5 * spec.loop_stall_deadline_seconds,
+                    events=(self._halt,),
+                )
+            finally:
+                self._suggest_inflight = False
+            if not self._current("suggest", gen):
+                # fenced: a replacement thread owns the frontier now —
+                # these proposals were never journaled, drop them
+                return
+            self._beat("suggest")
+            self._consumed_last_call = self._dispatched_total - d0
+            dur = time.perf_counter() - t0
+            obs.suggestion_latency.observe(dur, algorithm=spec.algorithm.name)
+            obs.suggest_seconds.observe(dur, algorithm=spec.algorithm.name)
+            if orch._tracer is not None and (
+                proposals or outcome in ("exhausted", "error") or dur >= 1e-3
+            ):
+                orch._tracer.record(
+                    "suggest",
+                    sug_start,
+                    dur,
+                    algorithm=spec.algorithm.name,
+                    count=len(proposals),
+                    outcome=outcome,
+                )
+            if outcome == "error":
+                self._suggester_busy = True
+                self._last_activity = time.monotonic()
+                obs.suggester_errors.inc(algorithm=spec.algorithm.name)
+            if proposals:
+                with self._state_lock:
+                    trials = [
+                        orch._materialize(
+                            exp,
+                            p,
+                            # rules attach at DISPATCH (_refresh_rules),
+                            # not here: a lookahead proposal materializes
+                            # long before the history its rule snapshot
+                            # would need
+                            None,
+                            self.suggester,
+                            condition=TrialCondition.PENDING,
+                            journal=False,
+                        )
+                        for p in proposals
+                    ]
+                # one durability barrier for the whole refill — per-trial
+                # appends would serialize ~lookahead fsyncs between the
+                # suggester returning and the first dispatch
+                orch._jappend_group("proposed", exp, trials)
+                with self._queue_lock:
+                    self._ready.extend(trials)
+                self._update_pending_gauge()
+                with self._state_lock:
+                    orch._persist_suggester(exp, self.suggester)
+                    orch._publish(exp)
+                self._last_activity = time.monotonic()
+            if outcome == "exhausted":
+                # set AFTER the final proposals are queued, so the
+                # terminal check never sees "exhausted + empty" early
+                self._exhausted.set()
+                return
+            if not proposals:
+                self._halt.wait(orch.poll_interval)
 
     # -- schedule loop -------------------------------------------------------
 
-    def _schedule_loop(self) -> None:
+    def _schedule_loop(self, gen: int = 0) -> None:
         orch = self.orch
-        try:
-            while not self._halt.is_set():
-                moved = self._pack_ready()
-                flushed = self._flush_buckets()
-                dispatched = self._dispatch_units()
-                if moved or flushed or dispatched:
-                    self._update_pending_gauge()
-                else:
-                    self._halt.wait(orch.poll_interval)
-        except Exception:
-            self._errors.append(
-                "schedule loop error:\n" + traceback.format_exc(limit=20)
-            )
-            self._halt.set()
+        while not self._halt.is_set() and self._current("schedule", gen):
+            self._seam("schedule")
+            moved = self._pack_ready()
+            flushed = self._flush_buckets()
+            dispatched = self._dispatch_units()
+            if moved or flushed or dispatched:
+                self._update_pending_gauge()
+                self._beat("schedule")
+            else:
+                self._halt.wait(orch.poll_interval)
 
     def _cohort_key_for(self, trial: Trial) -> str | None:
         if not self._use_cohorts:
@@ -504,6 +757,7 @@ class AsyncLoops:
             owner = unit
         with self._futures_lock:
             self.futures[fut] = owner
+            self._fut_meta[fut] = time.monotonic()
         self._dispatched_total += len(unit)
         self._last_activity = time.monotonic()
         # the harvest loop republishes status.json soon after: without
@@ -511,15 +765,38 @@ class AsyncLoops:
         # never show a Running trial to external watchers
         self._publish_dirty = True
 
-    # -- harvest loop (caller thread) ---------------------------------------
+    # -- harvest loop (thread) ----------------------------------------------
 
-    def _harvest_loop(self) -> Experiment:
+    def _harvest_loop(self, gen: int = 0) -> None:
+        """Thread body: poll/settle until a terminal (or drained) verdict,
+        published to the supervising caller thread via ``_result`` +
+        ``_done``.  Returning ``None`` from the cycle means this thread was
+        fenced out (restarted over) or lost the finalize race — the
+        replacement owns the verdict."""
+        result = self._harvest_cycle(gen)
+        if result is not None:
+            self._result = result
+            self._done.set()
+
+    def _finalize(self, fn):
+        """First-finalizer-wins: a stale harvest thread waking up mid
+        wind-down must not run ``_terminal``/``_drain`` a second time."""
+        with self._finalize_once:
+            if self._finalized:
+                return None
+            self._finalized = True
+        return fn()
+
+    def _harvest_cycle(self, gen: int) -> Experiment | None:
         orch, exp = self.orch, self.exp
-        while True:
-            if self._errors:
-                raise RuntimeError("; ".join(self._errors))
+        while not self._halt.is_set() and self._current("harvest", gen):
+            self._seam("harvest")
             with self._state_lock, self._futures_lock:
                 orch._harvest(exp, self.futures)
+            self._note_settled_futures()
+            self._check_speculations()
+            if self.spec.speculative_redispatch:
+                self._maybe_speculate()
             with self._futures_lock:
                 # busy in MEMBER trials: a running cohort future fills
                 # width slots' worth of the mesh on one pool thread
@@ -538,28 +815,30 @@ class AsyncLoops:
             if orch._stop_requested.is_set():
                 self.stop_event.set()
             if self.stop_event.is_set():
-                return self._terminal(
-                    ExperimentCondition.FAILED, message="experiment stopped"
+                return self._finalize(
+                    lambda: self._terminal(
+                        ExperimentCondition.FAILED, message="experiment stopped"
+                    )
                 )
             if orch._drain_requested.is_set():
-                return self._drain()
+                return self._finalize(self._drain)
 
             queued = self._queued_count()
             exhausted_eff = self._exhausted.is_set() and queued == 0
             with self._state_lock:
                 verdict = orch._check_terminal(exp, exhausted_eff, self.futures)
             if verdict is not None:
-                return self._terminal(verdict)
+                return self._finalize(lambda: self._terminal(verdict))
 
             if self.breaker.tripped:
-                return self._terminal(
-                    ExperimentCondition.FAILED,
-                    message=(
-                        f"suggester failed {self.breaker.failures} consecutive "
-                        f"times (suggester_max_errors="
-                        f"{self.spec.suggester_max_errors}); last error:\n"
-                        + self.breaker.last_failure
-                    ),
+                msg = (
+                    f"suggester failed {self.breaker.failures} consecutive "
+                    f"times (suggester_max_errors="
+                    f"{self.spec.suggester_max_errors}); last error:\n"
+                    + self.breaker.last_failure
+                )
+                return self._finalize(
+                    lambda: self._terminal(ExperimentCondition.FAILED, message=msg)
                 )
 
             # livelock guard (the sync loop's 30s stall cap): nothing in
@@ -572,16 +851,123 @@ class AsyncLoops:
                 and not self._suggest_inflight
             ):
                 if time.monotonic() - self._last_activity > _STALL_SECONDS:
-                    return self._terminal(
-                        ExperimentCondition.FAILED,
-                        message=(
-                            "orchestrator stalled: suggester proposes nothing "
-                            "with no trials in flight"
-                        ),
+                    return self._finalize(
+                        lambda: self._terminal(
+                            ExperimentCondition.FAILED,
+                            message=(
+                                "orchestrator stalled: suggester proposes "
+                                "nothing with no trials in flight"
+                            ),
+                        )
                     )
             else:
                 self._last_activity = max(self._last_activity, time.monotonic() - 1.0)
+            self._beat("harvest")
             time.sleep(orch.poll_interval)
+        return None
+
+    # -- speculative straggler re-dispatch -----------------------------------
+
+    def _note_settled_futures(self) -> None:
+        """Record settle durations (dispatch -> harvested) for the straggler
+        median; a future gone from the shared dict was settled/cancelled."""
+        now = time.monotonic()
+        with self._futures_lock:
+            gone = [f for f in self._fut_meta if f not in self.futures]
+            for f in gone:
+                self._settle_durations.append(now - self._fut_meta.pop(f))
+
+    def _maybe_speculate(self) -> None:
+        """Re-dispatch stragglers as singleton rivals on free slots.  Needs
+        >= 3 settled durations for a meaningful median; one rival per trial
+        per run; rivals only use slack under ``member_limit`` so speculation
+        never delays first-run work."""
+        if len(self._settle_durations) < 3:
+            return
+        threshold = self.spec.straggler_factor * statistics.median(
+            self._settle_durations
+        )
+        now = time.monotonic()
+        candidates: list[tuple[object, Trial]] = []
+        with self._futures_lock:
+            free = self.member_limit - self._undone_members() - len(
+                [f for f in self._rivals if not f.done()]
+            )
+            if free <= 0:
+                return
+            for f, owner in self.futures.items():
+                if f.done():
+                    continue
+                t0 = self._fut_meta.get(f)
+                if t0 is None or now - t0 < threshold:
+                    continue
+                for t in owner if isinstance(owner, list) else [owner]:
+                    if t.name not in self._speculated:
+                        candidates.append((f, t))
+        for f, t in candidates[: max(0, free)]:
+            self._dispatch_rival(f, t)
+
+    def _dispatch_rival(self, orig_fut, trial: Trial) -> None:
+        """Submit a speculative singleton rival for ``trial``.  The rival
+        executes a CLONE (separate object, suffixed checkpoint dir) so the
+        straggling attempt and the rival never write the same Trial or the
+        same checkpoint files; metrics land under the same trial name, so
+        adoption needs no metric surgery."""
+        self._speculated.add(trial.name)
+        clone = copy.deepcopy(trial)
+        if clone.checkpoint_dir:
+            clone.checkpoint_dir = clone.checkpoint_dir + "-speculative"
+        clone.condition = TrialCondition.RUNNING
+        clone.message = ""
+        fut = self.pool.submit(self.orch._execute, self.exp, clone, self.mesh)
+        with self._futures_lock:
+            self._rivals[fut] = (orig_fut, trial.name, clone)
+        obs.speculative_dispatches.inc()
+        self._last_activity = time.monotonic()
+
+    def _check_speculations(self) -> None:
+        """First-settle-wins arbitration.  A rival that finishes with a
+        usable result while the original is still unsettled is ADOPTED: the
+        clone becomes ``exp.trials[name]`` and its future joins the shared
+        dict, so the very next ``_harvest`` settles it through the normal
+        exactly-once path; the original future is evicted, and its eventual
+        result hits the stale-owner guard.  A rival that loses the race or
+        fails is discarded — speculation can never fail a trial that might
+        still succeed."""
+        if not self._rivals:
+            return
+        with self._futures_lock:
+            done = [f for f in self._rivals if f.done()]
+        for f in done:
+            with self._futures_lock:
+                orig_fut, name, clone = self._rivals.pop(f)
+            try:
+                result = f.result()  # _execute never raises
+            except Exception:
+                continue
+            live = self.exp.trials.get(name)
+            if live is None or live.condition.is_terminal():
+                continue  # the original settled first; rival discarded
+            if result.condition not in (
+                TrialCondition.SUCCEEDED,
+                TrialCondition.EARLY_STOPPED,
+            ):
+                continue
+            with self._state_lock, self._futures_lock:
+                self.futures.pop(orig_fut, None)
+                self._fut_meta.pop(orig_fut, None)
+                self.futures[f] = clone
+                self._fut_meta.setdefault(f, time.monotonic())
+                self.exp.trials[name] = clone
+            self._spec_wins += 1
+            obs.speculative_wins.inc()
+
+    def _cancel_rivals(self) -> None:
+        with self._futures_lock:
+            rivals = list(self._rivals)
+            self._rivals.clear()
+        for f in rivals:
+            f.cancel()
 
     # -- wind-down -----------------------------------------------------------
 
@@ -610,12 +996,15 @@ class AsyncLoops:
         return leftovers
 
     def _stop_loops(self) -> None:
-        """Halt the suggest/schedule threads and JOIN them before the
-        caller touches the queues or cancels futures — without the join, a
-        dispatch racing the wind-down could submit a unit after
-        ``_cancel_pending`` already ran."""
+        """Halt the loop threads and JOIN the current-generation ones before
+        the caller touches the queues or cancels futures — without the join,
+        a dispatch racing the wind-down could submit a unit after
+        ``_cancel_pending`` already ran.  Stale (restarted-over) threads are
+        already fenced out of shared state and left to die as daemons."""
         self._halt.set()
-        for t in getattr(self, "_threads", ()):
+        sup = self._supervisor
+        threads = sup.threads() if sup is not None else []
+        for t in threads:
             if t is not threading.current_thread():
                 t.join(timeout=_JOIN_TIMEOUT)
 
@@ -624,6 +1013,7 @@ class AsyncLoops:
     ) -> Experiment:
         orch, exp = self.orch, self.exp
         self._stop_loops()
+        self._cancel_rivals()
         self.stop_event.set()
         with self._futures_lock:
             orch._cancel_pending(self.futures)
@@ -652,6 +1042,7 @@ class AsyncLoops:
     def _drain(self) -> Experiment:
         orch, exp = self.orch, self.exp
         self._stop_loops()
+        self._cancel_rivals()
         # undispatched trials never started: back to PENDING so the resumed
         # run re-seeds them into its ready queue (no budget slot consumed)
         for t in self._drain_queues():
@@ -664,8 +1055,10 @@ class AsyncLoops:
         )
 
     def _record_stats(self) -> None:
-        """Publish the run's sustained-occupancy summary for bench/CI."""
+        """Publish the run's sustained-occupancy + supervision summary for
+        bench/CI/chaos assertions."""
         exp = self.exp
+        sup = self._supervisor
         elapsed = self.meter.elapsed()
         settled = sum(1 for t in exp.trials.values() if t.condition.is_terminal())
         self.orch.async_stats = {
@@ -676,5 +1069,9 @@ class AsyncLoops:
             "lookahead": self.lookahead,
             "width": self.width,
             "member_limit": self.member_limit,
+            "loop_restarts": sup.restart_counts() if sup is not None else {},
+            "fallback": self._fallback_reason,
+            "speculative_dispatches": len(self._speculated),
+            "speculative_wins": self._spec_wins,
         }
         obs.mesh_occupancy.set(0.0)

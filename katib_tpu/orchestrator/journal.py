@@ -73,6 +73,9 @@ EVENTS = (
     "retried",
     "drained",
     "experiment",
+    # supervisor audit trail: loop restarts / fallback decisions (no trial
+    # payload; replay merges any "exp" data and otherwise skips them)
+    "supervisor",
 )
 
 

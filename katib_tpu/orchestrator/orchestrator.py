@@ -398,7 +398,32 @@ class Orchestrator:
                     futures,
                     initial_ready=orphans,
                 )
-                return engine.run()
+                result = engine.run()
+                if result is not None:
+                    return result
+                # supervisor exhausted its loop-restart budget: degrade to
+                # this synchronous loop instead of dying.  In-flight futures
+                # stay live in the shared dict and are harvested below; the
+                # engine already journaled the fallback and put queued
+                # proposals back to PENDING — resubmit them here like
+                # restart orphans.
+                exhausted = engine._exhausted.is_set()
+                inflight: set[str] = set()
+                for owner in futures.values():
+                    for t in owner if isinstance(owner, list) else [owner]:
+                        inflight.add(t.name)
+                resubmit = [
+                    t
+                    for t in exp.trials.values()
+                    if t.condition
+                    in (TrialCondition.PENDING, TrialCondition.CREATED)
+                    and t.name not in inflight
+                ]
+                for trial in resubmit:
+                    trial.condition = TrialCondition.RUNNING
+                    trial.start_time = time.time()
+                    futures[pool.submit(self._execute, exp, trial, mesh)] = trial
+                self._jappend_group("started", exp, resubmit)
             while True:
                 self._harvest(exp, futures)
                 if self._stop_requested.is_set():
@@ -1274,43 +1299,78 @@ class Orchestrator:
                     self._jappend("settled", exp, trial=trial)
                     self._observe_trial_duration(trial)
                 continue
-            result = f.result()  # _execute / _execute_cohort never raise
+            try:
+                result = f.result()  # _execute / _execute_cohort never raise
+            except Exception as exc:
+                # the contract above is defense-in-depth, not a certainty: a
+                # pool-level failure for ONE future must settle its members
+                # as failed (classified through FailureKind), never raise
+                # out of the harvest loop and kill the whole experiment
+                kind = faults.classify_exception(exc)
+                result = TrialResult(
+                    TrialCondition.FAILED,
+                    f"settle failed: {exc!r}",
+                    failure_kind=kind,
+                )
             results = (
                 result if isinstance(result, dict) else {members[0].name: result}
             )
+            settled: list[Trial] = []
             for trial in members:
-                res = results.get(trial.name)
-                if res is None:  # defense: _execute_cohort backfills missing
-                    res = TrialResult(
-                        TrialCondition.FAILED,
-                        "cohort returned no result for member",
-                        failure_kind=faults.FailureKind.PERMANENT,
-                    )
-                trial.condition = res.condition
-                trial.message = res.message
-                fk = getattr(res, "failure_kind", None)
-                if fk is not None:
-                    trial.failure_kind = fk.value
-                elif not trial.retry_count:
-                    # keep the last failure's classification on a recovered
-                    # retry (journal answers "what did this trial survive?");
-                    # clean first-attempt results clear any resumed leftover
-                    trial.failure_kind = None
-                trial.completion_time = time.time()
-                if trial.condition in (
-                    TrialCondition.SUCCEEDED,
-                    TrialCondition.EARLY_STOPPED,
+                live = exp.trials.get(trial.name)
+                if (live is not None and live is not trial) or (
+                    trial.condition.is_terminal()
                 ):
-                    trial.observation = self.store.observation_for(
-                        trial.name, exp.spec.objective
-                    )
-                    if trial.observation is None:
-                        trial.condition = TrialCondition.METRICS_UNAVAILABLE
-                counter = self._TRIAL_COUNTERS.get(trial.condition)
-                if counter is not None:
-                    counter.inc()
-                self._observe_trial_duration(trial)
-                self._cleanup_trial(trial)
+                    # speculative first-settle-wins: a rival already settled
+                    # this member (the winner's object owns exp.trials[name])
+                    # — the loser's result is discarded, never re-journaled
+                    continue
+                try:
+                    res = results.get(trial.name)
+                    if res is None:  # defense: _execute_cohort backfills missing
+                        res = TrialResult(
+                            TrialCondition.FAILED,
+                            "cohort returned no result for member",
+                            failure_kind=faults.FailureKind.PERMANENT,
+                        )
+                    trial.condition = res.condition
+                    trial.message = res.message
+                    fk = getattr(res, "failure_kind", None)
+                    if fk is not None:
+                        trial.failure_kind = fk.value
+                    elif not trial.retry_count:
+                        # keep the last failure's classification on a recovered
+                        # retry (journal answers "what did this trial survive?");
+                        # clean first-attempt results clear any resumed leftover
+                        trial.failure_kind = None
+                    trial.completion_time = time.time()
+                    if trial.condition in (
+                        TrialCondition.SUCCEEDED,
+                        TrialCondition.EARLY_STOPPED,
+                    ):
+                        trial.observation = self.store.observation_for(
+                            trial.name, exp.spec.objective
+                        )
+                        if trial.observation is None:
+                            trial.condition = TrialCondition.METRICS_UNAVAILABLE
+                    counter = self._TRIAL_COUNTERS.get(trial.condition)
+                    if counter is not None:
+                        counter.inc()
+                    self._observe_trial_duration(trial)
+                    self._cleanup_trial(trial)
+                except Exception as exc:
+                    # per-member isolation: a bad metrics read / cleanup for
+                    # one member fails THAT member, classified, and the rest
+                    # of the cohort still settles normally
+                    kind = faults.classify_exception(exc)
+                    trial.condition = TrialCondition.FAILED
+                    trial.message = f"settle failed: {exc!r}"
+                    trial.failure_kind = kind.value
+                    if not trial.completion_time:
+                        trial.completion_time = time.time()
+                    obs.trials_failed.inc()
+                settled.append(trial)
+            members = settled
             exp.update_optimal()
             # durably journal each member's outcome: terminal conditions are
             # exactly-once settlements keyed by (trial, attempt epoch);

@@ -373,6 +373,10 @@ def experiment_spec_from_dict(data: Mapping[str, Any]) -> ExperimentSpec:
         ),
         occupancy_target=float(spec.get("occupancyTarget", 1.0)),
         cohort_fill_deadline_seconds=float(spec.get("cohortFillDeadlineSeconds", 2.0)),
+        loop_stall_deadline_seconds=float(spec.get("loopStallDeadlineSeconds", 60.0)),
+        loop_restart_budget=int(spec.get("loopRestartBudget", 3)),
+        speculative_redispatch=bool(spec.get("speculativeRedispatch", False)),
+        straggler_factor=float(spec.get("stragglerFactor", 4.0)),
     )
 
 

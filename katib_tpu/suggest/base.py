@@ -80,6 +80,8 @@ def call_suggester(
     count: int,
     breaker=None,
     injector=None,
+    deadline: float | None = None,
+    events: tuple = (),
 ) -> tuple[list[TrialAssignmentSet], str]:
     """One fault-isolated ``get_suggestions`` call — the single seam through
     which the orchestrator talks to an algorithm.
@@ -93,12 +95,25 @@ def call_suggester(
     those retries).  The caller checks ``breaker.tripped`` for the terminal
     verdict and ``breaker.allow()`` before calling again.  ``injector`` is
     the ``faults.FaultInjector`` chaos seam.
+
+    With ``deadline`` set the call runs on a daemon worker thread and a call
+    still blocked after ``deadline`` seconds is abandoned: the breaker
+    records the failure (bounded retries, then the experiment fails with a
+    diagnosis) instead of the caller blocking forever behind a wedged
+    algorithm.  The abandoned call's eventual result, if any, is discarded —
+    a proposal set that missed its deadline was never journaled.  ``events``
+    are stop/halt events a deadline wait also honors.
     """
     import traceback as _traceback
 
+    if deadline is not None:
+        return _call_suggester_deadline(
+            suggester, experiment, count, breaker, injector, deadline, events
+        )
+
     try:
         if injector is not None:
-            injector.on_suggester_call()
+            injector.on_suggester_call(events=events)
         proposals = suggester.get_suggestions(experiment, count)
     except SearchExhausted:
         if breaker is not None:
@@ -115,6 +130,57 @@ def call_suggester(
     if breaker is not None:
         breaker.record_success()
     return proposals, "ok"
+
+
+def _call_suggester_deadline(
+    suggester, experiment, count, breaker, injector, deadline, events
+) -> tuple[list[TrialAssignmentSet], str]:
+    """Deadline wrapper: the call itself runs (fault-isolated, no breaker —
+    the outer frame owns the verdict) on a daemon thread; a timeout is a
+    breaker failure with a "deadline" diagnosis."""
+    import threading
+    import traceback as _traceback
+
+    box: dict = {}
+
+    def _worker():
+        try:
+            if injector is not None:
+                injector.on_suggester_call(events=events)
+            box["result"] = (suggester.get_suggestions(experiment, count), "ok")
+        except SearchExhausted:
+            box["result"] = ([], "exhausted")
+        except SuggestionsNotReady:
+            box["result"] = ([], "not_ready")
+        except Exception:
+            box["traceback"] = _traceback.format_exc(limit=20)
+            box["result"] = ([], "error")
+
+    t = threading.Thread(target=_worker, name="katib-suggest-call", daemon=True)
+    t.start()
+    waited = 0.0
+    poll = min(0.05, deadline)
+    while waited < deadline and t.is_alive():
+        if any(ev.is_set() for ev in events):
+            break
+        t.join(poll)
+        waited += poll
+    if "result" not in box:
+        if breaker is not None:
+            breaker.record_failure(
+                f"get_suggestions exceeded its {deadline:.1f}s deadline "
+                "(call abandoned; see loopStallDeadlineSeconds)"
+            )
+        return [], "error"
+    proposals, outcome = box["result"]
+    if breaker is not None:
+        if outcome == "error":
+            breaker.record_failure(
+                box.get("traceback", "get_suggestions raised")
+            )
+        else:
+            breaker.record_success()
+    return proposals, outcome
 
 
 class Suggester(abc.ABC):

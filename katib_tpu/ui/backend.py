@@ -635,6 +635,11 @@ async function counters(){
   const occ=occM&&occM.samples.length?occM.samples[0].value:null;
   const pendM=m['katib_pending_proposals'];
   const pend=pendM&&pendM.samples.length?pendM.samples[0].value:null;
+  // loop-supervision strip: any loop whose stalled gauge is up right now,
+  // and the cumulative supervisor restart count across all loops
+  const stallM=m['katib_loop_stalled'];
+  const stalledLoops=stallM?stallM.samples.filter(x=>x.value>0)
+    .map(x=>(x.labels||{}).loop||'?'):[];
   const sugM=m['katib_suggest_seconds'];
   const sug=sugM&&sugM.total?(sugM.samples.reduce((a,x)=>a+x.sum,0)/sugM.total):null;
   document.getElementById('counters').innerHTML=
@@ -662,6 +667,9 @@ async function counters(){
     (spd!==null?` · steps/dispatch: ${spd.toFixed(1)}${spd<=1?' <b>EAGER</b>':''}`:'')+
     (occ!==null?` · occupancy: ${occ.toFixed(2)}${occ<0.5?' <b>MESH IDLE</b>':''}`:'')+
     (pend!==null?` · pending proposals: ${pend.toFixed(0)}`:'')+
+    (tot('katib_loop_restarts_total')?` · loop restarts: ${tot('katib_loop_restarts_total')}`:'')+
+    (stalledLoops.length?` · <b>LOOP STALLED: ${stalledLoops.map(esc).join(', ')}</b>`:'')+
+    (tot('katib_speculative_dispatch_total')?` · speculative: ${tot('katib_speculative_wins_total')}/${tot('katib_speculative_dispatch_total')} won`:'')+
     (sug!==null?` · suggest: ${sug.toFixed(3)}s`:'')+
     (mean!==null?` · mean trial ${mean.toFixed(1)}s`:'')+'</small>';
 }

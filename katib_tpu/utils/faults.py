@@ -207,6 +207,10 @@ class Backoff:
     ``delay(attempt)`` for 1-based attempts is ``base * factor**(attempt-1)``
     clamped to ``cap``, then scaled by a ±``jitter`` fraction drawn from a
     seeded RNG (same seed → same schedule, so chaos runs reproduce).
+    With ``full_jitter=True`` the delay is instead drawn uniformly from
+    ``[0, min(base * factor**(attempt-1), cap)]`` (AWS "full jitter") —
+    preferred when many actors may back off in lockstep (loop restarts,
+    suggester-timeout retries) because it decorrelates their wakeups.
     ``wait`` sleeps through ``stop_event.wait`` so a requested experiment
     stop is never delayed by a pending retry.
     """
@@ -218,15 +222,19 @@ class Backoff:
         cap: float = 30.0,
         jitter: float = 0.25,
         seed=None,
+        full_jitter: bool = False,
     ):
         self.base = max(0.0, float(base))
         self.factor = float(factor)
         self.cap = float(cap)
         self.jitter = float(jitter)
+        self.full_jitter = bool(full_jitter)
         self._rng = random.Random(seed)
 
     def delay(self, attempt: int) -> float:
         d = min(self.base * self.factor ** max(0, attempt - 1), self.cap)
+        if self.full_jitter:
+            return self._rng.uniform(0.0, d)
         if self.jitter:
             d *= 1.0 + self.jitter * (2.0 * self._rng.random() - 1.0)
         return max(0.0, min(d, self.cap))
@@ -369,6 +377,9 @@ class FaultInjector:
         self._compile_hangs: set[tuple[object, int]] = set()
         self._wedged_devices: set[int] = set()
         self._preempts: set[object] = set()
+        self._loop_kills: dict[str, list[int]] = {}
+        self._loop_iters: dict[str, int] = {}
+        self._suggester_stalls: dict[int, float] = {}
         self._flake_rate = 0.0
         self._flake_kind = FailureKind.TRANSIENT
         self._order: dict[str, int] = {}  # trial name -> creation index
@@ -435,6 +446,22 @@ class FaultInjector:
         self._flake_kind = FailureKind(kind)
         return self
 
+    def kill_loop(self, loop: str, at_iteration: int = 1):
+        """Raise out of async loop ``loop`` ('suggest' | 'schedule' |
+        'harvest') at the top of its ``at_iteration``-th (1-based) iteration
+        — the thread dies exactly the way an unhandled bug would, and only
+        the supervisor can notice.  Fires once per arm."""
+        self._loop_kills.setdefault(str(loop), []).append(int(at_iteration))
+        return self
+
+    def stall_suggester(self, seconds: float, call: int = 1):
+        """Wedge the ``call``-th (1-based) ``get_suggestions`` call for
+        ``seconds`` (stop-event responsive): exercises the suggester-timeout
+        path — the call must trip the CircuitBreaker via its deadline
+        instead of blocking the suggest loop forever."""
+        self._suggester_stalls[int(call)] = float(seconds)
+        return self
+
     # -- seams --------------------------------------------------------------
 
     def attempts_of(self, trial_name: str) -> int:
@@ -485,15 +512,38 @@ class FaultInjector:
                 kind,
             )
 
-    def on_suggester_call(self) -> None:
+    def on_suggester_call(self, events: tuple = (), poll: float = 0.02) -> None:
         """Orchestrator seam, called inside the fault-isolated
-        ``get_suggestions`` wrapper."""
+        ``get_suggestions`` wrapper.  May stall (``stall_suggester``) or
+        raise (``fail_suggester``)."""
         with self._lock:
             self._suggester_count += 1
             n = self._suggester_count
+            stall = self._suggester_stalls.pop(n, 0.0)
+        if stall > 0.0:
+            self.log.append({"seam": "suggester-stall", "call": n, "seconds": stall})
+            deadline = time.monotonic() + stall
+            while time.monotonic() < deadline:
+                if any(ev.is_set() for ev in events):
+                    break
+                time.sleep(poll)
         if n in self._suggester_calls:
             self.log.append({"seam": "suggester", "call": n})
             raise InjectedFault(f"injected suggester fault: call={n}")
+
+    def on_loop_iteration(self, loop: str) -> None:
+        """Async-loop seam, called at the top of every suggest/schedule/
+        harvest loop iteration *outside all locks*.  Raises to kill the
+        thread when a ``kill_loop`` arm matches this iteration."""
+        with self._lock:
+            n = self._loop_iters[loop] = self._loop_iters.get(loop, 0) + 1
+            arms = self._loop_kills.get(loop)
+            fire = bool(arms) and n in arms
+            if fire:
+                arms.remove(n)
+        if fire:
+            self.log.append({"seam": "kill-loop", "loop": loop, "iteration": n})
+            raise InjectedFault(f"injected loop kill: loop={loop} iteration={n}")
 
     def apply_metrics_delay(self, trial, stop_event: threading.Event | None = None) -> None:
         """Runner seam: stall the trial's metric production (exercises

@@ -435,6 +435,28 @@ mesh_occupancy = REGISTRY.gauge(
     "Fraction of executor slots busy with dispatched trials "
     "(sustained < 0.5 means the mesh idles between cohorts)",
 )
+loop_restarts = REGISTRY.counter(
+    "katib_loop_restarts_total",
+    "Async loop threads restarted by the supervisor, by loop= label "
+    "(suggest/schedule/harvest); a climbing count is a restart storm — "
+    "check the journal's supervisor events for the crash tracebacks",
+)
+loop_stalled = REGISTRY.gauge(
+    "katib_loop_stalled",
+    "1 while the supervisor classifies the loop= labeled async loop as "
+    "STALLED (alive but its progress watermark is frozen past "
+    "loopStallDeadlineSeconds with upstream work available), else 0",
+)
+speculative_dispatches = REGISTRY.counter(
+    "katib_speculative_dispatch_total",
+    "Straggler trials speculatively re-dispatched as singletons "
+    "(stragglerFactor x median settle time exceeded)",
+)
+speculative_wins = REGISTRY.counter(
+    "katib_speculative_wins_total",
+    "Speculative re-dispatches that settled before their original attempt "
+    "(a low win/dispatch ratio means stragglerFactor is too aggressive)",
+)
 
 # -- vectorized trial cohorts (runner/cohort.py) ------------------------------
 

@@ -43,15 +43,23 @@ class Heartbeat:
     """One registered trial's progress pulse.  ``beat()`` is the only method
     trial code touches; it is safe from any thread and allocation-free."""
 
-    __slots__ = ("name", "deadline", "on_hang", "_last", "_fired", "_wd")
+    __slots__ = (
+        "name", "deadline", "on_hang", "count_metric", "_last", "_fired",
+        "_silenced", "_wd",
+    )
 
-    def __init__(self, wd: "Watchdog", name: str, deadline: float, on_hang):
+    def __init__(
+        self, wd: "Watchdog", name: str, deadline: float, on_hang,
+        count_metric: bool = True,
+    ):
         self._wd = wd
         self.name = name
         self.deadline = float(deadline)
         self.on_hang = on_hang
+        self.count_metric = count_metric
         self._last = wd._clock()
         self._fired = False
+        self._silenced = False
 
     def beat(self) -> None:
         """Record progress (resets the stall clock)."""
@@ -61,6 +69,24 @@ class Heartbeat:
     def fired(self) -> bool:
         """True once the watchdog classified this trial as hung."""
         return self._fired
+
+    @property
+    def last(self) -> float:
+        """Clock value of the most recent ``beat()`` (the raw watermark)."""
+        return self._last
+
+    def silence(self) -> None:
+        """Stop scanning this heartbeat without unregistering it — used by
+        the loop supervisor while a loop is legitimately idle (STARVED):
+        no-work silence must not count toward its stall deadline."""
+        self._silenced = True
+
+    def reset(self) -> None:
+        """Re-arm after ``silence()`` or after a fire — the stall clock
+        restarts from now (a restarted loop begins with a clean deadline)."""
+        self._silenced = False
+        self._fired = False
+        self._last = self._wd._clock()
 
     def close(self) -> None:
         self._wd.unregister(self)
@@ -75,9 +101,10 @@ class Watchdog:
     ``deadline + interval``.
     """
 
-    def __init__(self, interval: float = 0.25, clock=time.monotonic):
+    def __init__(self, interval: float = 0.25, clock=time.monotonic, start: bool = True):
         self.interval = float(interval)
         self._clock = clock
+        self._autostart = bool(start)
         self._lock = threading.Lock()
         self._beats: list[Heartbeat] = []
         self._stop = threading.Event()
@@ -89,13 +116,16 @@ class Watchdog:
         name: str,
         deadline: float,
         on_hang: Callable[[str], None] | None = None,
+        count_metric: bool = True,
     ) -> Heartbeat:
         """Start watching a trial; returns its :class:`Heartbeat` handle.
-        ``on_hang(name)`` fires at most once, from the monitor thread."""
-        hb = Heartbeat(self, name, deadline, on_hang)
+        ``on_hang(name)`` fires at most once, from the monitor thread.
+        ``count_metric=False`` keeps a fire out of ``katib_trial_hangs_total``
+        (supervisor loop heartbeats are not trial hangs)."""
+        hb = Heartbeat(self, name, deadline, on_hang, count_metric=count_metric)
         with self._lock:
             self._beats.append(hb)
-            if self._thread is None:
+            if self._thread is None and self._autostart:
                 self._stop.clear()
                 self._thread = threading.Thread(
                     target=self._monitor, name="katib-watchdog", daemon=True
@@ -136,7 +166,9 @@ class Watchdog:
             stalled = [
                 hb
                 for hb in self._beats
-                if not hb._fired and now - hb._last > hb.deadline
+                if not hb._fired
+                and not hb._silenced
+                and now - hb._last > hb.deadline
             ]
             for hb in stalled:
                 hb._fired = True
@@ -145,7 +177,8 @@ class Watchdog:
             from katib_tpu.utils import observability as obs
 
             for hb in stalled:
-                obs.trial_hangs.inc()
+                if hb.count_metric:
+                    obs.trial_hangs.inc()
                 if hb.on_hang is not None:
                     try:
                         hb.on_hang(hb.name)
