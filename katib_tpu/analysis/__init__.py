@@ -1,0 +1,34 @@
+"""Static analysis and runtime concurrency witnesses for katib-tpu.
+
+Three tools live here, surfaced through ``katib-tpu lint``:
+
+- :mod:`~katib_tpu.analysis.lockcheck` — AST lock-discipline checker over
+  classes that declare ``_GUARDS = guarded_by(...)``.
+- :mod:`~katib_tpu.analysis.jaxcheck` — AST JAX-hazard checker (host syncs
+  in hot loops, jit-in-loop, static_argnums, donation reuse, unsynced
+  bench timing).
+- :mod:`~katib_tpu.analysis.witness` — runtime lock-order witness
+  (``KATIB_LOCK_WITNESS=1``) recording the process-wide lock-acquisition
+  graph and turning lock-order inversions into hard failures.
+
+This ``__init__`` stays import-light (stdlib only): production modules
+import ``guarded_by``/``make_lock`` from here at module-import time.
+"""
+
+from .guards import guarded_by
+from .witness import (
+    LockOrderInversion,
+    make_lock,
+    witness_enabled,
+    witness_reset,
+    witness_summary,
+)
+
+__all__ = [
+    "guarded_by",
+    "make_lock",
+    "witness_enabled",
+    "witness_reset",
+    "witness_summary",
+    "LockOrderInversion",
+]

@@ -1,0 +1,79 @@
+"""Finding record shared by the AST checkers and the lint driver."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One lint finding.
+
+    ``fingerprint`` deliberately excludes the line number so baseline
+    entries survive unrelated edits; ``detail`` disambiguates multiple
+    findings of the same code inside one symbol (usually the attribute
+    or callee name involved).
+    """
+
+    code: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    symbol: str  # Class.method or function the finding is in
+    detail: str  # attribute / callee the finding is about
+    message: str
+    hint: str = ""
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.code}:{self.path}:{self.symbol}:{self.detail}"
+
+    def render(self) -> str:
+        out = f"{self.path}:{self.line}: {self.code} [{self.symbol}] {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+
+# hazard-code registry: code -> (title, default fix hint) -----------------
+CODES = {
+    "LCK001": (
+        "unguarded access to a guarded attribute",
+        "wrap the access in `with self.<lock>:`, annotate the def with "
+        "`# lint: holds(<lock>)` if every caller holds it, or suppress with "
+        "`# lint: unguarded-ok(<reason>)`",
+    ),
+    "LCK002": (
+        "guarded attribute escapes to another thread",
+        "pass an immutable snapshot (or the lock itself) into the thread/executor "
+        "instead of the guarded object",
+    ),
+    "JAX101": (
+        "host sync inside a hot (scan/jit-loop) body",
+        "keep the body device-pure; fetch results once after the loop "
+        "(`float()`/`.item()`/`np.asarray` force a device round-trip per step)",
+    ),
+    "JAX102": (
+        "jax.jit constructed inside a loop body",
+        "hoist the jit() call out of the loop (each call builds a fresh cache entry "
+        "and retraces)",
+    ),
+    "JAX103": (
+        "non-hashable operand passed at a static_argnums position",
+        "pass a hashable value (tuple, int, frozen dataclass) — lists/dicts/sets "
+        "raise or silently retrace per call",
+    ),
+    "JAX104": (
+        "donated buffer reused after donate_argnums call",
+        "rebind the name from the call's result; the donated input buffer is "
+        "invalidated by XLA and reads return garbage on TPU",
+    ),
+    "JAX105": (
+        "timing boundary without a device sync",
+        "call jax.block_until_ready(...) (or force a host fetch) before stopping "
+        "the timer; otherwise the number measures dispatch, not device time",
+    ),
+}
+
+
+def hint_for(code: str) -> str:
+    return CODES.get(code, ("", ""))[1]

@@ -1,0 +1,448 @@
+"""AST JAX-hazard checker.
+
+Flags the dispatch-purity hazards that silently eat the fused-loop win
+(ROADMAP item 1):
+
+- **JAX101** host sync (`float()`/`.item()`/`np.asarray`/`device_get`)
+  inside a hot body — a `lax.scan`/`fori_loop`/`while_loop` body
+  function, or a loop inside a jit-decorated function.  Each one is a
+  device round-trip per step.
+- **JAX102** ``jax.jit``/``pjit`` constructed inside a ``for``/``while``
+  body — a fresh cache entry and retrace per iteration.
+- **JAX103** a non-hashable literal (list/dict/set/comprehension) passed
+  at a ``static_argnums`` position of a jit-wrapped callable.
+- **JAX104** a buffer reused after being donated: ``g = jax.jit(f,
+  donate_argnums=(0,))``; ``out = g(x)``; any later read of ``x``
+  before rebinding.  XLA invalidates the input buffer — reads return
+  garbage on TPU and only *happen* to work on CPU.
+- **JAX105** (bench files only) a ``time.perf_counter()`` delta whose
+  timed region contains real work but no device sync
+  (``block_until_ready`` / host fetch) — the number measures dispatch,
+  not device time.  See the 93x-inflation note in ``bench.py``.
+
+All checks are intraprocedural and name-based (no imports, no type
+inference); ``# lint: unguarded-ok(<reason>)`` suppresses any finding on
+its line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from .findings import Finding, hint_for
+from .guards import is_suppressed, parse_annotations
+
+_JIT_NAMES = {"jit", "pjit"}
+_SCAN_TAILS = {"scan"}  # lax.scan / jax.lax.scan / bare scan
+_NP_MODULES = {"np", "numpy", "onp"}
+_HOST_SYNC_ATTRS = {"item", "tolist"}
+_SYNC_CALL_MARKERS = ("block_until_ready", "device_get", "barrier")
+_TRIVIAL_CALLS = {
+    "perf_counter", "monotonic", "time", "sleep", "print", "len", "range",
+    "enumerate", "zip", "min", "max", "sorted", "abs", "round", "isinstance",
+    "getattr", "setattr", "str", "repr", "format", "append", "extend", "join",
+    "items", "keys", "values", "get", "pop", "list", "dict", "tuple", "set",
+    "sum", "int", "bool", "strip", "split", "write", "flush", "debug", "info",
+    "warning", "error",
+}
+
+
+def _callee_tail(func: ast.AST) -> Optional[str]:
+    """Last dotted component of a call target (``jax.lax.scan`` -> ``scan``)."""
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    return _callee_tail(call.func) in _JIT_NAMES
+
+
+def _int_tuple(node: ast.AST) -> Tuple[int, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+        return tuple(out)
+    return ()
+
+
+def _jit_info(call: ast.Call) -> Dict[str, Tuple[int, ...]]:
+    info = {"static": (), "donate": ()}
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            info["static"] = _int_tuple(kw.value)
+        elif kw.arg == "donate_argnums":
+            info["donate"] = _int_tuple(kw.value)
+    return info
+
+
+def _walk_scope(stmts: List[ast.stmt]) -> Iterator[ast.AST]:
+    """Walk statements without descending into nested def/class/lambda."""
+    stack: List[ast.AST] = list(stmts)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _scopes(tree: ast.Module) -> List[Tuple[str, ast.AST, List[ast.stmt]]]:
+    """Every (symbol, node, body) scope: the module plus each function."""
+    out: List[Tuple[str, ast.AST, List[ast.stmt]]] = [("<module>", tree, tree.body)]
+
+    def rec(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                name = f"{prefix}.{child.name}" if prefix else child.name
+                out.append((name, child, child.body))
+                rec(child, name)
+            elif isinstance(child, ast.ClassDef):
+                rec(child, f"{prefix}.{child.name}" if prefix else child.name)
+            else:
+                rec(child, prefix)
+
+    rec(tree, "")
+    return out
+
+
+class _Checker:
+    def __init__(self, source: str, path: str, timing: bool) -> None:
+        self.tree = ast.parse(source, filename=path)
+        self.path = path
+        self.timing = timing
+        self.suppressed, _ = parse_annotations(source)
+        self.findings: List[Finding] = []
+        self.scopes = _scopes(self.tree)
+        self.defs_by_name: Dict[str, List[ast.AST]] = {}
+        self.symbol_of: Dict[int, str] = {}
+        for sym, node, _body in self.scopes:
+            self.symbol_of[id(node)] = sym
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs_by_name.setdefault(node.name, []).append(node)
+        # name -> static/donate positions, from `g = jax.jit(f, ...)` anywhere
+        self.jits: Dict[str, Dict[str, Tuple[int, ...]]] = {}
+        for node in ast.walk(self.tree):
+            if (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and _is_jit_call(node.value)
+            ):
+                info = _jit_info(node.value)
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.jits[t.id] = info
+
+    # ------------------------------------------------------------------
+    def run(self) -> List[Finding]:
+        self._check_host_sync()
+        self._check_jit_in_loop()
+        self._check_static_args()
+        self._check_donation()
+        if self.timing:
+            self._check_timing()
+        # hot regions can nest (a loop inside a loop inside a jitted fn):
+        # keep one finding per (code, line, detail)
+        unique: Dict[Tuple[str, int, str], Finding] = {}
+        for f in self.findings:
+            unique.setdefault((f.code, f.line, f.detail), f)
+        return list(unique.values())
+
+    def _emit(self, code: str, node: ast.AST, symbol: str, detail: str, message: str) -> None:
+        if is_suppressed(self.suppressed, node.lineno, getattr(node, "end_lineno", None)):
+            return
+        self.findings.append(
+            Finding(
+                code=code,
+                path=self.path,
+                line=node.lineno,
+                symbol=symbol,
+                detail=detail,
+                message=message,
+                hint=hint_for(code),
+            )
+        )
+
+    # -- JAX101 ---------------------------------------------------------
+    def _hot_bodies(self) -> List[Tuple[str, List[ast.stmt], str]]:
+        """(symbol, stmts, why) regions where a host sync is a hazard."""
+        hot: List[Tuple[str, List[ast.stmt], str]] = []
+        seen: set = set()
+
+        def mark(fn_node: ast.AST, why: str) -> None:
+            if id(fn_node) in seen:
+                return
+            seen.add(id(fn_node))
+            if isinstance(fn_node, ast.Lambda):
+                hot.append(("<lambda>", [ast.Expr(value=fn_node.body)], why))
+            else:
+                sym = self.symbol_of.get(id(fn_node), getattr(fn_node, "name", "?"))
+                hot.append((sym, fn_node.body, why))
+
+        def mark_arg(arg: ast.AST, why: str) -> None:
+            if isinstance(arg, ast.Lambda):
+                mark(arg, why)
+            elif isinstance(arg, ast.Name):
+                for fn_node in self.defs_by_name.get(arg.id, ()):
+                    mark(fn_node, why)
+
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                tail = _callee_tail(node.func)
+                if tail in _SCAN_TAILS and node.args:
+                    mark_arg(node.args[0], "lax.scan body")
+                elif tail == "fori_loop" and len(node.args) >= 3:
+                    mark_arg(node.args[2], "fori_loop body")
+                elif tail == "while_loop" and len(node.args) >= 2:
+                    mark_arg(node.args[0], "while_loop cond")
+                    mark_arg(node.args[1], "while_loop body")
+        # loops inside jit-decorated functions
+        for sym, node, body in self.scopes:
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not any(self._is_jit_decorator(d) for d in node.decorator_list):
+                continue
+            for sub in _walk_scope(body):
+                if isinstance(sub, (ast.For, ast.While)):
+                    hot.append((sym, sub.body + sub.orelse, "loop in jitted fn"))
+        return hot
+
+    @staticmethod
+    def _is_jit_decorator(dec: ast.AST) -> bool:
+        if _callee_tail(dec) in _JIT_NAMES:
+            return True
+        if isinstance(dec, ast.Call):
+            if _callee_tail(dec.func) in _JIT_NAMES:
+                return True
+            if _callee_tail(dec.func) == "partial" and dec.args:
+                return _callee_tail(dec.args[0]) in _JIT_NAMES
+        return False
+
+    def _check_host_sync(self) -> None:
+        for sym, stmts, why in self._hot_bodies():
+            for node in _walk_scope(stmts):
+                if not isinstance(node, ast.Call):
+                    continue
+                reason = self._host_sync_kind(node)
+                if reason:
+                    self._emit(
+                        "JAX101", node, sym, reason,
+                        f"{reason} inside a {why} forces a device round-trip per step",
+                    )
+
+    @staticmethod
+    def _host_sync_kind(call: ast.Call) -> Optional[str]:
+        func = call.func
+        if isinstance(func, ast.Name) and func.id in ("float", "int", "bool"):
+            if call.args and not isinstance(call.args[0], ast.Constant):
+                return f"{func.id}()"
+        if isinstance(func, ast.Attribute):
+            if func.attr in _HOST_SYNC_ATTRS:
+                return f".{func.attr}()"
+            if func.attr in ("asarray", "array") and isinstance(func.value, ast.Name):
+                if func.value.id in _NP_MODULES:
+                    return f"{func.value.id}.{func.attr}()"
+            if func.attr == "device_get":
+                return "device_get()"
+        return None
+
+    # -- JAX102 ---------------------------------------------------------
+    def _check_jit_in_loop(self) -> None:
+        for sym, _node, body in self.scopes:
+            for sub in _walk_scope(body):
+                if not isinstance(sub, (ast.For, ast.While)):
+                    continue
+                for inner in _walk_scope(sub.body + sub.orelse):
+                    if isinstance(inner, ast.Call) and _is_jit_call(inner):
+                        self._emit(
+                            "JAX102", inner, sym, _callee_tail(inner.func) or "jit",
+                            "jit() constructed inside a loop body retraces every iteration",
+                        )
+
+    # -- JAX103 ---------------------------------------------------------
+    _NONHASHABLE = (
+        ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp,
+        ast.GeneratorExp,
+    )
+
+    def _check_static_args(self) -> None:
+        for sym, _node, body in self.scopes:
+            for sub in _walk_scope(body):
+                if not isinstance(sub, ast.Call):
+                    continue
+                static: Tuple[int, ...] = ()
+                callee = "?"
+                if isinstance(sub.func, ast.Name) and sub.func.id in self.jits:
+                    static = self.jits[sub.func.id]["static"]
+                    callee = sub.func.id
+                elif isinstance(sub.func, ast.Call) and _is_jit_call(sub.func):
+                    static = _jit_info(sub.func)["static"]
+                    callee = "jit(...)"
+                for idx in static:
+                    if idx < len(sub.args) and isinstance(sub.args[idx], self._NONHASHABLE):
+                        self._emit(
+                            "JAX103", sub.args[idx], sym, f"{callee}[{idx}]",
+                            f"non-hashable literal at static_argnums position {idx} "
+                            f"of {callee}",
+                        )
+
+    # -- JAX104 ---------------------------------------------------------
+    def _check_donation(self) -> None:
+        donators = {n: i["donate"] for n, i in self.jits.items() if i["donate"]}
+        if not donators:
+            return
+        for sym, _node, body in self.scopes:
+            self._scan_donation(body, donators, sym)
+
+    def _scan_donation(self, stmts, donators, sym) -> None:
+        dead: Dict[str, int] = {}
+
+        def revive(target: ast.AST) -> None:
+            if isinstance(target, ast.Name):
+                dead.pop(target.id, None)
+            elif isinstance(target, (ast.Tuple, ast.List)):
+                for elt in target.elts:
+                    revive(elt)
+            elif isinstance(target, ast.Starred):
+                revive(target.value)
+
+        def expr(node: ast.AST) -> None:
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                if node.id in dead:
+                    self._emit(
+                        "JAX104", node, sym, node.id,
+                        f"{node.id!r} read after being donated on line {dead[node.id]}",
+                    )
+                    dead.pop(node.id, None)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)):
+                return
+            if isinstance(node, ast.Call):
+                expr(node.func)
+                for a in node.args:
+                    expr(a)
+                for kw in node.keywords:
+                    expr(kw.value)
+                if isinstance(node.func, ast.Name) and node.func.id in donators:
+                    for idx in donators[node.func.id]:
+                        if idx < len(node.args) and isinstance(node.args[idx], ast.Name):
+                            dead[node.args[idx].id] = node.lineno
+                return
+            for child in ast.iter_child_nodes(node):
+                expr(child)
+
+        def stmt(node: ast.stmt) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                return  # nested scopes are scanned on their own
+            if isinstance(node, ast.Assign):
+                expr(node.value)
+                for t in node.targets:
+                    revive(t)
+            elif isinstance(node, ast.AugAssign):
+                expr(node.value)
+                expr(node.target)
+                revive(node.target)
+            elif isinstance(node, ast.AnnAssign):
+                if node.value is not None:
+                    expr(node.value)
+                revive(node.target)
+            elif isinstance(node, ast.For):
+                expr(node.iter)
+                revive(node.target)
+                for s in node.body + node.orelse:
+                    stmt(s)
+            elif isinstance(node, (ast.While, ast.If)):
+                expr(node.test)
+                for s in node.body + node.orelse:
+                    stmt(s)
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    expr(item.context_expr)
+                    if item.optional_vars is not None:
+                        revive(item.optional_vars)
+                for s in node.body:
+                    stmt(s)
+            elif isinstance(node, ast.Try):
+                for s in node.body + node.orelse + node.finalbody:
+                    stmt(s)
+                for handler in node.handlers:
+                    for s in handler.body:
+                        stmt(s)
+            else:
+                for child in ast.iter_child_nodes(node):
+                    expr(child)
+
+        for s in stmts:
+            stmt(s)
+
+    # -- JAX105 ---------------------------------------------------------
+    def _check_timing(self) -> None:
+        for sym, _node, body in self.scopes:
+            starts: List[Tuple[str, int]] = []  # (timer name, line)
+            stops: List[Tuple[str, int, ast.AST]] = []
+            calls: List[Tuple[int, str]] = []  # (line, kind)
+            for node in _walk_scope(body):
+                if isinstance(node, ast.Assign) and self._is_clock_call(node.value):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            starts.append((t.id, node.lineno))
+                elif (
+                    isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Sub)
+                    and self._is_clock_call(node.left)
+                    and isinstance(node.right, ast.Name)
+                ):
+                    stops.append((node.right.id, node.lineno, node))
+                if isinstance(node, ast.Call):
+                    calls.append((node.lineno, self._call_kind(node)))
+            for timer, stop_line, stop_node in stops:
+                cands = [ln for (t, ln) in starts if t == timer and ln < stop_line]
+                if not cands:
+                    continue
+                start_line = max(cands)
+                region = [
+                    kind for (ln, kind) in calls if start_line < ln <= stop_line
+                ]
+                if "work" in region and "sync" not in region:
+                    self._emit(
+                        "JAX105", stop_node, sym, timer,
+                        f"timer {timer!r} stopped without a device sync in the "
+                        f"timed region (started line {start_line})",
+                    )
+
+    @staticmethod
+    def _is_clock_call(node: ast.AST) -> bool:
+        return (
+            isinstance(node, ast.Call)
+            and _callee_tail(node.func) in ("perf_counter", "monotonic")
+        )
+
+    def _call_kind(self, call: ast.Call) -> str:
+        tail = _callee_tail(call.func) or ""
+        low = tail.lower()
+        if any(marker in low for marker in _SYNC_CALL_MARKERS):
+            return "sync"
+        if self._host_sync_kind(call):
+            return "sync"  # a host fetch forces completion too
+        if tail in _TRIVIAL_CALLS or tail in ("perf_counter", "monotonic"):
+            return "trivial"
+        return "work"
+
+
+def check_source(source: str, path: str, timing: bool = False) -> List[Finding]:
+    return _Checker(source, path, timing).run()
+
+
+def check_file(filename: str, relpath: Optional[str] = None, timing: bool = False) -> List[Finding]:
+    with open(filename, "r", encoding="utf-8") as f:
+        source = f.read()
+    return check_source(source, relpath or filename, timing)

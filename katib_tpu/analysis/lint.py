@@ -1,0 +1,151 @@
+"""``katib-tpu lint`` driver: run the checkers, ratchet against a baseline.
+
+The baseline file (``artifacts/lint/baseline.json``) holds fingerprints
+of *accepted* findings.  ``run_lint`` fails only on findings whose
+fingerprint is not in the baseline, so existing debt is ratcheted down
+(a fixed finding's stale fingerprint is reported and pruned by
+``--update-baseline``), never flag-dayed — and never silently grown.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from . import jaxcheck, lockcheck
+from .findings import Finding
+
+# lock-discipline pass: any module may declare guards; scan the package.
+DEFAULT_LOCK_PATHS = ("katib_tpu",)
+# JAX-hazard pass: the dispatch-sensitive layers named by the discipline
+# (parallel, nas/darts+enas, ops, trial/model code, the runner).
+DEFAULT_JAX_PATHS = (
+    "katib_tpu/parallel",
+    "katib_tpu/nas",
+    "katib_tpu/ops",
+    "katib_tpu/models",
+    "katib_tpu/runner",
+)
+# timing-boundary rule (JAX105) only applies to benchmark entry points.
+DEFAULT_TIMING_FILES = ("bench.py",)
+
+BASELINE_DEFAULT = os.path.join("artifacts", "lint", "baseline.json")
+
+
+@dataclass
+class LintReport:
+    findings: List[Finding] = field(default_factory=list)
+    new: List[Finding] = field(default_factory=list)
+    baselined: List[Finding] = field(default_factory=list)
+    stale_baseline: List[str] = field(default_factory=list)
+    files_scanned: int = 0
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.new else 0
+
+    def to_json(self) -> dict:
+        return {
+            "files_scanned": self.files_scanned,
+            "new": [f.__dict__ for f in self.new],
+            "baselined": [f.__dict__ for f in self.baselined],
+            "stale_baseline": list(self.stale_baseline),
+        }
+
+
+def _iter_py(root: str, rel: str) -> List[str]:
+    """Repo-relative .py paths under *rel* (a file or a directory)."""
+    full = os.path.join(root, rel)
+    if os.path.isfile(full):
+        return [rel] if rel.endswith(".py") else []
+    out: List[str] = []
+    for dirpath, dirnames, filenames in os.walk(full):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                out.append(
+                    os.path.relpath(os.path.join(dirpath, fn), root).replace(os.sep, "/")
+                )
+    return out
+
+
+def collect_findings(
+    root: str = ".",
+    lock_paths: Sequence[str] = DEFAULT_LOCK_PATHS,
+    jax_paths: Sequence[str] = DEFAULT_JAX_PATHS,
+    timing_files: Sequence[str] = DEFAULT_TIMING_FILES,
+) -> tuple:
+    """Run both AST passes; returns (findings, files_scanned)."""
+    findings: List[Finding] = []
+    seen_files = set()
+
+    lock_files = []
+    for rel in lock_paths:
+        lock_files.extend(_iter_py(root, rel))
+    for rel in lock_files:
+        seen_files.add(rel)
+        findings.extend(lockcheck.check_file(os.path.join(root, rel), rel))
+
+    jax_files = []
+    for rel in jax_paths:
+        jax_files.extend(_iter_py(root, rel))
+    for rel in jax_files:
+        seen_files.add(rel)
+        findings.extend(jaxcheck.check_file(os.path.join(root, rel), rel))
+
+    for rel in timing_files:
+        if os.path.isfile(os.path.join(root, rel)):
+            seen_files.add(rel)
+            findings.extend(
+                jaxcheck.check_file(os.path.join(root, rel), rel, timing=True)
+            )
+
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    return findings, len(seen_files)
+
+
+def load_baseline(path: Optional[str]) -> List[str]:
+    if not path or not os.path.isfile(path):
+        return []
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    return list(doc.get("findings", []))
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    doc = {
+        "version": 1,
+        "comment": (
+            "Accepted lint debt, by fingerprint (code:path:symbol:detail). "
+            "The ratchet: katib-tpu lint fails on findings NOT in this list. "
+            "Only shrink it; grow it only with a reviewed justification."
+        ),
+        "findings": sorted(f.fingerprint for f in findings),
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+
+
+def run_lint(
+    root: str = ".",
+    baseline_path: Optional[str] = None,
+    lock_paths: Sequence[str] = DEFAULT_LOCK_PATHS,
+    jax_paths: Sequence[str] = DEFAULT_JAX_PATHS,
+    timing_files: Sequence[str] = DEFAULT_TIMING_FILES,
+) -> LintReport:
+    findings, nfiles = collect_findings(root, lock_paths, jax_paths, timing_files)
+    accepted = set(load_baseline(baseline_path))
+    report = LintReport(findings=findings, files_scanned=nfiles)
+    found_fps: Dict[str, bool] = {}
+    for f in findings:
+        found_fps[f.fingerprint] = True
+        if f.fingerprint in accepted:
+            report.baselined.append(f)
+        else:
+            report.new.append(f)
+    report.stale_baseline = sorted(fp for fp in accepted if fp not in found_fps)
+    return report

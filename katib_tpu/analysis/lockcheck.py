@@ -1,0 +1,236 @@
+"""AST lock-discipline checker.
+
+Walks every class that declares ``_GUARDS = guarded_by(...)`` and flags:
+
+- **LCK001** — a read or write of a guarded attribute (``self.<attr>``)
+  outside a lexical ``with self.<lock>:`` scope for the declared lock.
+- **LCK002** — a guarded attribute handed into ``Thread(target=...)`` or
+  an executor submission (``.submit``/``.map``/``.apply_async``): the
+  receiving thread runs outside the lock regardless of what the caller
+  holds.
+
+Escape hatches (see :mod:`~katib_tpu.analysis.guards`):
+``# lint: holds(<lock>)`` on a ``def`` line declares locks every caller
+holds; ``# lint: unguarded-ok(<reason>)`` suppresses a finding on that
+line.  ``__init__`` is exempt from LCK001 — construction happens before
+the object is published to other threads.
+
+Limits (deliberate — this is a discipline checker, not an escape
+analysis): lock scopes are lexical only (``.acquire()``/``.release()``
+pairs are invisible), nested functions inherit the lexical held-set even
+though a closure could outlive the scope, and aliasing
+(``d = self._seen``) is not tracked.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .findings import Finding, hint_for
+from .guards import is_suppressed, parse_annotations
+
+_GUARDS_NAME = "_GUARDS"
+_THREAD_CTORS = {"Thread", "Timer"}
+_SUBMIT_METHODS = {"submit", "map", "apply_async", "run_in_executor"}
+
+
+def _literal_str_tuple(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if not (isinstance(elt, ast.Constant) and isinstance(elt.value, str)):
+                return None
+            out.append(elt.value)
+        return tuple(out)
+    return None
+
+
+def extract_guards(cls: ast.ClassDef) -> Dict[str, str]:
+    """Read the ``_GUARDS = guarded_by(...)`` declaration literally."""
+    for stmt in cls.body:
+        targets = []
+        value = None
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        if not any(isinstance(t, ast.Name) and t.id == _GUARDS_NAME for t in targets):
+            continue
+        if not isinstance(value, ast.Call):
+            continue
+        func = value.func
+        name = func.id if isinstance(func, ast.Name) else (
+            func.attr if isinstance(func, ast.Attribute) else None
+        )
+        if name != "guarded_by":
+            continue
+        mapping: Dict[str, str] = {}
+        for kw in value.keywords:
+            if kw.arg is None:
+                continue
+            attrs = _literal_str_tuple(kw.value)
+            if attrs is None:
+                continue
+            for attr in attrs:
+                mapping[attr] = kw.arg
+        return mapping
+    return {}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+class _MethodScanner:
+    def __init__(
+        self,
+        path: str,
+        cls_name: str,
+        guards: Dict[str, str],
+        suppressed: Dict[int, str],
+        holds: Dict[int, Tuple[str, ...]],
+    ) -> None:
+        self.path = path
+        self.cls_name = cls_name
+        self.guards = guards
+        self.lock_names = set(guards.values())
+        self.suppressed = suppressed
+        self.holds = holds
+        self.findings: List[Finding] = []
+        self._escaped: set = set()  # nodes already reported as LCK002
+
+    # -- entry ----------------------------------------------------------
+    def scan(self, fn: ast.AST) -> None:
+        assert isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+        self.symbol = f"{self.cls_name}.{fn.name}"
+        self.check_reads = fn.name != "__init__"
+        held: Set[str] = set()
+        for ln in range(fn.lineno, fn.body[0].lineno + 1):
+            held.update(self.holds.get(ln, ()))
+        for stmt in fn.body:
+            self._visit(stmt, held)
+
+    # -- recursion ------------------------------------------------------
+    def _visit(self, node: ast.AST, held: Set[str]) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired: Set[str] = set()
+            for item in node.items:
+                attr = _self_attr(item.context_expr)
+                if attr is not None and (attr in self.lock_names or attr.endswith("lock")):
+                    acquired.add(attr)
+                else:
+                    self._visit(item.context_expr, held)
+                if item.optional_vars is not None:
+                    self._visit(item.optional_vars, held)
+            for stmt in node.body:
+                self._visit(stmt, held | acquired)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested helper: inherits the lexical held-set plus its own
+            # holds() declaration (documented limitation for escaping closures)
+            inner = set(held)
+            for ln in range(node.lineno, node.body[0].lineno + 1):
+                inner.update(self.holds.get(ln, ()))
+            for stmt in node.body:
+                self._visit(stmt, inner)
+            return
+        if isinstance(node, ast.Call):
+            self._check_escape(node, held)
+        attr = _self_attr(node)
+        if (
+            attr is not None
+            and self.check_reads
+            and attr in self.guards
+            and id(node) not in self._escaped
+        ):
+            lock = self.guards[attr]
+            if lock not in held and not is_suppressed(
+                self.suppressed, node.lineno, getattr(node, "end_lineno", None)
+            ):
+                self.findings.append(
+                    Finding(
+                        code="LCK001",
+                        path=self.path,
+                        line=node.lineno,
+                        symbol=self.symbol,
+                        detail=attr,
+                        message=(
+                            f"access to self.{attr} (guarded by {lock}) "
+                            f"without holding {lock}"
+                        ),
+                        hint=hint_for("LCK001"),
+                    )
+                )
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, held)
+
+    # -- cross-thread escape -------------------------------------------
+    def _check_escape(self, call: ast.Call, held: Set[str]) -> None:
+        func = call.func
+        is_thread = (isinstance(func, ast.Name) and func.id in _THREAD_CTORS) or (
+            isinstance(func, ast.Attribute) and func.attr in _THREAD_CTORS
+        )
+        is_submit = isinstance(func, ast.Attribute) and func.attr in _SUBMIT_METHODS
+        if not (is_thread or is_submit):
+            return
+        payload = list(call.args) + [kw.value for kw in call.keywords]
+        for arg in payload:
+            for sub in ast.walk(arg):
+                attr = _self_attr(sub)
+                if attr is None or attr not in self.guards:
+                    continue
+                self._escaped.add(id(sub))
+                if is_suppressed(
+                    self.suppressed, sub.lineno, getattr(sub, "end_lineno", None)
+                ) or is_suppressed(
+                    self.suppressed, call.lineno, getattr(call, "end_lineno", None)
+                ):
+                    continue
+                self.findings.append(
+                    Finding(
+                        code="LCK002",
+                        path=self.path,
+                        line=sub.lineno,
+                        symbol=self.symbol,
+                        detail=attr,
+                        message=(
+                            f"self.{attr} (guarded by {self.guards[attr]}) handed to "
+                            "another thread — the receiver runs outside the lock"
+                        ),
+                        hint=hint_for("LCK002"),
+                    )
+                )
+
+
+def check_source(source: str, path: str) -> List[Finding]:
+    """Run the lock-discipline pass over one module's source."""
+    tree = ast.parse(source, filename=path)
+    suppressed, holds = parse_annotations(source)
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        guards = extract_guards(node)
+        if not guards:
+            continue
+        scanner = _MethodScanner(path, node.name, guards, suppressed, holds)
+        for stmt in node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scanner.scan(stmt)
+        findings.extend(scanner.findings)
+    return findings
+
+
+def check_file(filename: str, relpath: Optional[str] = None) -> List[Finding]:
+    with open(filename, "r", encoding="utf-8") as f:
+        source = f.read()
+    return check_source(source, relpath or filename)

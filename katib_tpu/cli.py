@@ -1391,6 +1391,45 @@ def cmd_doctor(args: argparse.Namespace) -> int:
     return 0 if report.ok() else 1
 
 
+def cmd_lint(args: argparse.Namespace) -> int:
+    """Concurrency-discipline + JAX-hazard static analysis over the tree
+    (see ``katib_tpu/analysis/``).  Exit non-zero on any finding whose
+    fingerprint is not in the committed baseline — the ratchet: debt can
+    only shrink, never silently grow."""
+    from katib_tpu.analysis.lint import run_lint, write_baseline
+
+    # a relative baseline names a file inside the scanned tree, not the cwd
+    baseline = (
+        args.baseline
+        if os.path.isabs(args.baseline)
+        else os.path.join(args.root, args.baseline)
+    )
+    report = run_lint(root=args.root, baseline_path=baseline)
+    if args.update_baseline:
+        write_baseline(baseline, report.findings)
+        print(
+            f"baseline updated: {baseline} "
+            f"({len(report.findings)} accepted fingerprint(s))"
+        )
+        return 0
+    if args.json:
+        print(json.dumps(report.to_json(), indent=2))
+        return report.exit_code
+    for f in report.new:
+        print(f.render())
+    if report.baselined:
+        print(f"{len(report.baselined)} baselined finding(s) suppressed")
+    for fp in report.stale_baseline:
+        print(f"stale baseline entry (finding fixed — prune it): {fp}")
+    status = "FAIL" if report.new else "ok"
+    print(
+        f"lint {status}: {report.files_scanned} files scanned, "
+        f"{len(report.new)} new finding(s), "
+        f"{len(report.stale_baseline)} stale baseline entr(y/ies)"
+    )
+    return report.exit_code
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="katib-tpu", description="TPU-native AutoML framework CLI"
@@ -1704,6 +1743,32 @@ def main(argv: list[str] | None = None) -> int:
         "repeatable",
     )
     p.set_defaults(fn=cmd_doctor)
+
+    p = sub.add_parser(
+        "lint",
+        help="concurrency-discipline + JAX-hazard static analysis "
+        "(exit 0 = no findings beyond the committed baseline)",
+    )
+    p.add_argument(
+        "--root", default=".", help="repository root to scan (default: cwd)"
+    )
+    p.add_argument(
+        "--baseline",
+        default=os.path.join("artifacts", "lint", "baseline.json"),
+        help="accepted-findings fingerprint file (the ratchet)",
+    )
+    p.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="rewrite the baseline to exactly the current findings "
+        "(prunes stale entries; growing it needs review)",
+    )
+    p.add_argument(
+        "--json",
+        action="store_true",
+        help="machine-readable report (new/baselined/stale findings)",
+    )
+    p.set_defaults(fn=cmd_lint)
 
     args = parser.parse_args(argv)
     try:

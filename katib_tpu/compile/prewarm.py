@@ -37,6 +37,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Mapping
 
+from katib_tpu.analysis import guarded_by, make_lock
 from katib_tpu.compile.registry import (
     REGISTRY,
     CompileSignature,
@@ -91,12 +92,16 @@ class PrewarmRequest:
 class PrewarmWorker:
     """Daemon-thread compile worker over a bounded queue of requests."""
 
+    # the worker thread bumps the counters; the CLI/tests read them after
+    # drain() — both sides go through _lock, like the thread handle itself
+    _GUARDS = guarded_by(_lock=("_thread", "compiled", "failed"))
+
     def __init__(self, registry: ShapeRegistry = REGISTRY, max_queue: int = 64):
         self._registry = registry
         self._queue: queue.Queue = queue.Queue(maxsize=max_queue)
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
-        self._lock = threading.Lock()
+        self._lock = make_lock("prewarm.worker")
         self.compiled = 0  # successful prewarm compiles (tests/CLI)
         self.failed = 0
 
@@ -133,7 +138,8 @@ class PrewarmWorker:
             try:
                 self._compile(req)
             except Exception:
-                self.failed += 1
+                with self._lock:  # LCK001: counter read from the caller thread
+                    self.failed += 1
                 _log.warning(
                     "prewarm compile failed for %s (best-effort, trial will "
                     "compile live)",
@@ -156,7 +162,8 @@ class PrewarmWorker:
         fn(dict(req.shared), int(req.k), req.mesh)
         elapsed = time.perf_counter() - started
         if self._registry.record(sig, source="prewarm", compile_seconds=elapsed):
-            self.compiled += 1
+            with self._lock:  # LCK001: counter read from the caller thread
+                self.compiled += 1
             obs.prewarm_compiles.inc(program=sig.program)
 
     def drain(self, timeout: float = 30.0) -> bool:
@@ -176,6 +183,7 @@ class PrewarmWorker:
         in flight keeps running on the daemon thread and is abandoned at
         process exit — by design, nothing waits on it."""
         self._stop.set()
-        t = self._thread
+        with self._lock:  # LCK001: _ensure_thread writes _thread under _lock
+            t = self._thread
         if t is not None and t.is_alive():
             t.join(timeout)

@@ -37,10 +37,10 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterable, Mapping, Sequence
 
+from katib_tpu.analysis import guarded_by, make_lock
 from katib_tpu.utils import observability as obs
 
 _REGISTRY_FILENAME = "shape_registry.jsonl"
@@ -160,10 +160,20 @@ def _cache_dir() -> str | None:
 
 
 class ShapeRegistry:
-    """Thread-safe compiled-signature set with optional JSONL persistence."""
+    """Thread-safe compiled-signature set with optional JSONL persistence.
+
+    Reached from the caller thread (trial runner first steps), the async
+    harvest thread (settlement-time classification), and the prewarm
+    worker — every access to the signature map, the loaded-dir marker,
+    and the torn-tail truncation offset goes through ``_lock``, including
+    the JSONL append (``_append`` orders truncate-then-append against
+    concurrent recorders).
+    """
+
+    _GUARDS = guarded_by(_lock=("_seen", "_loaded_dir", "_truncate_to"))
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = make_lock("compile.registry")
         self._seen: dict[str, dict] = {}
         self._loaded_dir: str | None = None
         # byte length of the valid prefix when the registry file ends in a
@@ -177,7 +187,7 @@ class ShapeRegistry:
         d = _cache_dir()
         return os.path.join(d, _REGISTRY_FILENAME) if d else None
 
-    def _maybe_load(self) -> None:
+    def _maybe_load(self) -> None:  # lint: holds(_lock)
         """Lazily fold the cache dir's registry file into memory, once per
         directory (a later init_compile_cache of a different dir reloads)."""
         d = _cache_dir()
@@ -223,7 +233,7 @@ class ShapeRegistry:
         except OSError:
             pass
 
-    def _append(self, rec: dict) -> None:
+    def _append(self, rec: dict) -> None:  # lint: holds(_lock)
         path = self._path()
         if path is None:
             return
@@ -271,8 +281,11 @@ class ShapeRegistry:
             fresh = key not in self._seen
             if fresh:
                 self._seen[key] = rec
-        if fresh:
-            self._append(rec)
+                # LCK001 fix: _append reads/clears _truncate_to and must
+                # order truncate-then-append against concurrent recorders
+                # (harvest thread vs. caller thread both classify here) —
+                # it used to run after the lock was dropped
+                self._append(rec)
         return fresh
 
     def classify(self, sig: CompileSignature) -> str:

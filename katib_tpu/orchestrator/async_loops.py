@@ -73,6 +73,7 @@ import threading
 import time
 import traceback
 
+from katib_tpu.analysis import guarded_by, make_lock
 from katib_tpu.core.types import (
     COHORT_KEY_LABEL,
     Experiment,
@@ -137,6 +138,23 @@ class AsyncLoops:
     while-loop body inside ``Orchestrator.run``'s pool context and returns
     the terminal (or drained) experiment."""
 
+    # the queues move together (see the module docstring's discipline
+    # section), and the dispatch/consumption counters move WITH the queues
+    # they describe — the suggest loop's bank-deficit estimate must read
+    # both under the same lock or the refill races the scheduler's drain.
+    # The futures-side set covers everything the scheduler inserts while
+    # the harvest thread iterates, including the speculation bookkeeping.
+    _GUARDS = guarded_by(
+        _queue_lock=(
+            "_ready", "_packing", "_pack_ts", "_dispatchq",
+            "_dispatched_total", "_consumed_last_call",
+        ),
+        _futures_lock=(
+            "futures", "_fut_meta", "_rivals", "_speculated",
+            "_settle_durations",
+        ),
+    )
+
     def __init__(
         self,
         orch,
@@ -163,9 +181,9 @@ class AsyncLoops:
         self.drain_event = drain_event
         self.futures = futures
 
-        self._state_lock = threading.Lock()
-        self._queue_lock = threading.Lock()
-        self._futures_lock = threading.Lock()
+        self._state_lock = make_lock("async.state")
+        self._queue_lock = make_lock("async.queue")
+        self._futures_lock = make_lock("async.futures")
 
         #: proposed trials awaiting packing (suggest -> schedule hand-off)
         self._ready: collections.deque[Trial] = collections.deque(initial_ready)
@@ -186,7 +204,7 @@ class AsyncLoops:
         self._done = threading.Event()
         #: first-finalizer-wins guard: a restarted-over stale harvest thread
         #: waking up mid-wind-down must not run _terminal/_drain twice
-        self._finalize_once = threading.Lock()
+        self._finalize_once = make_lock("async.finalize")
         self._finalized = False
         self._supervisor = None  # LoopSupervisor, built in run()
         self._fallback_reason: str | None = None
@@ -347,7 +365,7 @@ class AsyncLoops:
         breaker is cooling down."""
         if self._exhausted.is_set() or not self.breaker.allow():
             return False
-        want = self.lookahead - self._queued_count() + self._consumed_last_call
+        want = self._bank_deficit()
         if self.spec.max_trial_count is not None:
             want = min(want, self.spec.max_trial_count - len(self.exp.trials))
         return want > 0
@@ -477,11 +495,7 @@ class AsyncLoops:
             # mesh starves briefly every cycle.  Adding the members
             # consumed during the LAST call (a one-step rate estimate)
             # keeps the bank at the full lookahead when the call lands.
-            want = (
-                self.lookahead
-                - self._queued_count()
-                + self._consumed_last_call
-            )
+            want = self._bank_deficit()
             if spec.max_trial_count is not None:
                 want = min(want, spec.max_trial_count - len(exp.trials))
             if want <= 0:
@@ -496,7 +510,8 @@ class AsyncLoops:
             self._suggester_busy = False
             sug_start = orch._tracer.elapsed() if orch._tracer else 0.0
             t0 = time.perf_counter()
-            d0 = self._dispatched_total
+            with self._queue_lock:  # LCK001: the scheduler bumps it in _submit
+                d0 = self._dispatched_total
             self._suggest_inflight = True
             try:
                 # the deadline bounds a wedged/blocked get_suggestions:
@@ -523,7 +538,11 @@ class AsyncLoops:
                 # these proposals were never journaled, drop them
                 return
             self._beat("suggest")
-            self._consumed_last_call = self._dispatched_total - d0
+            # LCK001 fix: the rate estimate is read by _bank_deficit on this
+            # thread AND the supervisor's has_work probe on the caller
+            # thread; write it under the same lock the counters live under
+            with self._queue_lock:
+                self._consumed_last_call = self._dispatched_total - d0
             dur = time.perf_counter() - t0
             obs.suggestion_latency.observe(dur, algorithm=spec.algorithm.name)
             obs.suggest_seconds.observe(dur, algorithm=spec.algorithm.name)
@@ -676,8 +695,7 @@ class AsyncLoops:
                     flushed += 1
         return flushed
 
-    def _undone_members(self) -> int:
-        # called under _futures_lock
+    def _undone_members(self) -> int:  # lint: holds(_futures_lock)
         return sum(
             (len(o) if isinstance(o, list) else 1)
             for f, o in self.futures.items()
@@ -740,8 +758,7 @@ class AsyncLoops:
             if not t.spec.early_stopping_rules:
                 t.spec.early_stopping_rules = rules
 
-    def _submit(self, unit: list[Trial]) -> None:
-        # called under _queue_lock
+    def _submit(self, unit: list[Trial]) -> None:  # lint: holds(_queue_lock)
         orch, exp = self.orch, self.exp
         orch._submit_prewarm(self.spec, unit, self.mesh)
         now = time.time()
@@ -825,7 +842,10 @@ class AsyncLoops:
 
             queued = self._queued_count()
             exhausted_eff = self._exhausted.is_set() and queued == 0
-            with self._state_lock:
+            # LCK001 fix: _check_terminal's exhaustion arm tests the futures
+            # dict while the scheduler may be inserting — hold both locks
+            # (state > futures, same order as the harvest call above)
+            with self._state_lock, self._futures_lock:
                 verdict = orch._check_terminal(exp, exhausted_eff, self.futures)
             if verdict is not None:
                 return self._finalize(lambda: self._terminal(verdict))
@@ -882,11 +902,14 @@ class AsyncLoops:
         >= 3 settled durations for a meaningful median; one rival per trial
         per run; rivals only use slack under ``member_limit`` so speculation
         never delays first-run work."""
-        if len(self._settle_durations) < 3:
+        # LCK001 fix: _note_settled_futures appends on this thread, but a
+        # restarted-over stale harvest generation can still be unwinding —
+        # snapshot under the lock before taking the median
+        with self._futures_lock:
+            durations = list(self._settle_durations)
+        if len(durations) < 3:
             return
-        threshold = self.spec.straggler_factor * statistics.median(
-            self._settle_durations
-        )
+        threshold = self.spec.straggler_factor * statistics.median(durations)
         now = time.monotonic()
         candidates: list[tuple[object, Trial]] = []
         with self._futures_lock:
@@ -913,7 +936,6 @@ class AsyncLoops:
         straggling attempt and the rival never write the same Trial or the
         same checkpoint files; metrics land under the same trial name, so
         adoption needs no metric surgery."""
-        self._speculated.add(trial.name)
         clone = copy.deepcopy(trial)
         if clone.checkpoint_dir:
             clone.checkpoint_dir = clone.checkpoint_dir + "-speculative"
@@ -921,6 +943,9 @@ class AsyncLoops:
         clone.message = ""
         fut = self.pool.submit(self.orch._execute, self.exp, clone, self.mesh)
         with self._futures_lock:
+            # LCK001 fix: _maybe_speculate filters candidates against
+            # _speculated under this lock; the add used to race it bare
+            self._speculated.add(trial.name)
             self._rivals[fut] = (orig_fut, trial.name, clone)
         obs.speculative_dispatches.inc()
         self._last_activity = time.monotonic()
@@ -934,9 +959,11 @@ class AsyncLoops:
         result hits the stale-owner guard.  A rival that loses the race or
         fails is discarded — speculation can never fail a trial that might
         still succeed."""
-        if not self._rivals:
-            return
+        # LCK001 fix: the empty-check early-return used to peek at _rivals
+        # bare; fold it into the lock (uncontended acquire, same fast path)
         with self._futures_lock:
+            if not self._rivals:
+                return
             done = [f for f in self._rivals if f.done()]
         for f in done:
             with self._futures_lock:
@@ -973,13 +1000,32 @@ class AsyncLoops:
 
     def _queued_count(self) -> int:
         with self._queue_lock:
+            return self._queued_count_locked()
+
+    def _queued_count_locked(self) -> int:  # lint: holds(_queue_lock)
+        return (
+            len(self._ready)
+            + sum(len(b) for b in self._packing.values())
+            + sum(len(u) for u in self._dispatchq)
+        )
+
+    def _bank_deficit(self) -> int:
+        """How many proposals the bank is short of ``lookahead``, with the
+        one-step consumption estimate folded in — read atomically under the
+        queue lock (the counters move with the queues they describe)."""
+        with self._queue_lock:
             return (
-                len(self._ready)
-                + sum(len(b) for b in self._packing.values())
-                + sum(len(u) for u in self._dispatchq)
+                self.lookahead
+                - self._queued_count_locked()
+                + self._consumed_last_call
             )
 
     def _update_pending_gauge(self) -> None:
+        # straggler-reset fix: run()'s finally zeroes this gauge after the
+        # halt flag is raised; a loop thread still unwinding through here
+        # must not republish a nonzero count after that reset
+        if self._halt.is_set():
+            return
         obs.pending_proposals.set(float(self._queued_count()))
 
     def _drain_queues(self) -> list[Trial]:
@@ -1051,7 +1097,11 @@ class AsyncLoops:
             orch._jappend("drained", exp, trial=t)
         self._record_stats()
         return orch._drain_and_exit(
-            exp, self.futures, self.suggester, self.stop_event, self.drain_event
+            exp,
+            self.futures,  # lint: unguarded-ok(wind-down: loops joined by _stop_loops, single-threaded from here)
+            self.suggester,
+            self.stop_event,
+            self.drain_event,
         )
 
     def _record_stats(self) -> None:
@@ -1071,7 +1121,7 @@ class AsyncLoops:
             "member_limit": self.member_limit,
             "loop_restarts": sup.restart_counts() if sup is not None else {},
             "fallback": self._fallback_reason,
-            "speculative_dispatches": len(self._speculated),
+            "speculative_dispatches": len(self._speculated),  # lint: unguarded-ok(wind-down: _record_stats runs after _stop_loops joined the loops)
             "speculative_wins": self._spec_wins,
         }
         obs.mesh_occupancy.set(0.0)

@@ -346,6 +346,24 @@ def run_soak(
             f"post-fault occupancy did not recover: {after:.2f} < "
             f"{OCCUPANCY_RECOVERY} x baseline {base:.2f}"
         )
+    # lock-order witness (KATIB_LOCK_WITNESS=1): every engine lock acquired
+    # across every round fed the process-wide acquisition graph; an observed
+    # inversion of the documented order (state > queue > futures, plus the
+    # registry/metrics/watchdog locks) fails the soak even if no round
+    # actually deadlocked — the witness sees the near-miss
+    from katib_tpu.analysis import witness_enabled
+    from katib_tpu.analysis.witness import format_summary, witness_cycles
+
+    if witness_enabled():
+        cycles = witness_cycles()
+        if verbose or cycles:
+            print(format_summary())
+        if cycles:
+            failures.append(
+                f"lock-order witness observed {len(cycles)} inversion(s) "
+                "of the documented acquire order: "
+                + "; ".join(" -> ".join(c) for c in cycles[:3])
+            )
     elapsed = time.monotonic() - start
     if failures:
         print(

@@ -53,6 +53,7 @@ import threading
 import time
 from typing import Callable
 
+from katib_tpu.analysis import guarded_by, make_lock
 from katib_tpu.utils import observability as obs
 from katib_tpu.utils.faults import Backoff
 from katib_tpu.utils.watchdog import Watchdog
@@ -97,6 +98,12 @@ class LoopSupervisor:
     engine's caller thread); ``beat``/``generation`` are safe from any.
     """
 
+    # the loop registry is read by beat()/generation() from the loop
+    # threads while tick()/add() mutate it on the caller thread; the
+    # per-loop _Loop fields themselves are tick-thread-only by contract
+    # (beat touches only the Heartbeat, which is lock-free by design)
+    _GUARDS = guarded_by(_gen_lock=("_loops",))
+
     def __init__(
         self,
         stall_deadline: float = 60.0,
@@ -119,7 +126,7 @@ class LoopSupervisor:
         # registry only — no monitor thread; tick() is the scan
         self._wd = Watchdog(clock=clock, start=False)
         self._loops: dict[str, _Loop] = {}
-        self._gen_lock = threading.Lock()
+        self._gen_lock = make_lock("supervisor.gen")
         self._fallback_reason: str | None = None
 
     # -- registration / watermarks ------------------------------------------
@@ -139,12 +146,18 @@ class LoopSupervisor:
         hb = self._wd.register(
             f"loop:{name}", self.stall_deadline, count_metric=False
         )
-        self._loops[name] = _Loop(name, spawn, has_work, finished, spawn(0), hb)
+        lp = _Loop(name, spawn, has_work, finished, spawn(0), hb)
+        # LCK001 fix: the generation-0 thread is already running and may
+        # beat()/generation() concurrently — publish the record under the
+        # same lock those readers take
+        with self._gen_lock:
+            self._loops[name] = lp
 
     def beat(self, name: str) -> None:
         """Progress watermark bump — call on real work only (enqueue,
         dispatch, settle), never on an idle poll."""
-        lp = self._loops.get(name)
+        with self._gen_lock:  # LCK001: add() publishes records concurrently
+            lp = self._loops.get(name)
         if lp is not None:
             lp.hb.beat()
 
@@ -168,18 +181,25 @@ class LoopSupervisor:
         return self._fallback_reason
 
     def restart_counts(self) -> dict[str, int]:
-        return {name: lp.restarts for name, lp in self._loops.items()}
+        with self._gen_lock:  # LCK001: registry snapshot vs concurrent add()
+            return {name: lp.restarts for name, lp in self._loops.items()}
 
     def threads(self) -> list[threading.Thread]:
         """Current-generation threads (stale wedged ones are abandoned)."""
-        return [lp.thread for lp in self._loops.values()]
+        with self._gen_lock:  # LCK001: registry snapshot vs concurrent add()
+            return [lp.thread for lp in self._loops.values()]
 
     # -- the scan ------------------------------------------------------------
 
     def tick(self) -> dict[str, str]:
         """Classify every loop, perform due restarts, return name→state."""
         now = self._clock()
-        return {name: self._tick_loop(lp, now) for name, lp in self._loops.items()}
+        # snapshot, then classify OUTSIDE the lock: _restart bumps the
+        # generation under _gen_lock (non-reentrant), and spawn/on_restart
+        # callbacks may call generation() themselves
+        with self._gen_lock:
+            loops = list(self._loops.items())
+        return {name: self._tick_loop(lp, now) for name, lp in loops}
 
     def _tick_loop(self, lp: _Loop, now: float) -> str:
         if lp.finished() and not lp.thread.is_alive():

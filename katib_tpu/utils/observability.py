@@ -22,6 +22,8 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Iterable
 
+from katib_tpu.analysis import guarded_by, make_lock
+
 
 def _escape_label_value(v: str) -> str:
     """Text exposition format: backslash, double-quote, and newline must be
@@ -40,12 +42,14 @@ def _format_value(value: float) -> str:
 
 
 class _Metric:
+    _GUARDS = guarded_by(_lock=("_values",))
+
     def __init__(self, name: str, help_text: str, kind: str):
         self.name = name
         self.help = help_text
         self.kind = kind
         self._values: dict[tuple[tuple[str, str], ...], float] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.metric")
 
     def _key(self, labels: dict[str, str]) -> tuple[tuple[str, str], ...]:
         return tuple(sorted(labels.items()))
@@ -121,6 +125,8 @@ DEFAULT_BUCKETS = (
 class _Histogram(_Metric):
     """Prometheus histogram: per-series bucket counts + sum + count, rendered
     as cumulative ``_bucket{le=...}`` / ``_sum`` / ``_count`` series."""
+
+    _GUARDS = guarded_by(_lock=("_series",))
 
     def __init__(
         self,
@@ -217,9 +223,11 @@ class _Histogram(_Metric):
 
 
 class MetricsRegistry:
+    _GUARDS = guarded_by(_lock=("_metrics",))
+
     def __init__(self) -> None:
         self._metrics: dict[str, _Metric] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics.registry")
 
     def counter(self, name: str, help_text: str = "") -> _Metric:
         return self._register(name, help_text, "counter")

@@ -38,6 +38,8 @@ import threading
 import time
 from typing import Callable
 
+from katib_tpu.analysis import guarded_by, make_lock
+
 
 class Heartbeat:
     """One registered trial's progress pulse.  ``beat()`` is the only method
@@ -101,11 +103,16 @@ class Watchdog:
     ``deadline + interval``.
     """
 
+    # Heartbeat's own fields (_last/_fired/_silenced) are deliberately
+    # lock-free: beat() must be allocation-free and safe from any thread,
+    # and a stale read only delays hang detection by one scan interval.
+    _GUARDS = guarded_by(_lock=("_beats", "_thread", "hang_count"))
+
     def __init__(self, interval: float = 0.25, clock=time.monotonic, start: bool = True):
         self.interval = float(interval)
         self._clock = clock
         self._autostart = bool(start)
-        self._lock = threading.Lock()
+        self._lock = make_lock("watchdog.beats")
         self._beats: list[Heartbeat] = []
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -144,10 +151,14 @@ class Watchdog:
         """Stop the monitor thread (idempotent); registered heartbeats stay
         valid but are no longer scanned."""
         self._stop.set()
-        thread = self._thread
+        # LCK001 fix: take+clear the thread handle under the lock (a
+        # concurrent register() reads _thread to decide whether to spawn);
+        # join OUTSIDE it — the monitor's _scan takes the same lock
+        with self._lock:
+            thread = self._thread
+            self._thread = None
         if thread is not None:
             thread.join(timeout=2.0)
-        self._thread = None
 
     def check_now(self) -> list[str]:
         """Run one scan synchronously (deterministic tests with a fake

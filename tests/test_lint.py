@@ -1,0 +1,539 @@
+"""The static-analysis suite (``katib_tpu/analysis``): one fixture per
+hazard code for both AST passes, the runtime lock-order witness, the
+baseline ratchet, and the repo-clean gate CI relies on.
+
+Fixture modules are SOURCE STRINGS, not imports — both checkers are
+AST-only by design (they must lint jax-touching files without jax
+installed), so the fixtures never execute.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import textwrap
+import threading
+
+import pytest
+
+from katib_tpu.analysis import guards as G
+from katib_tpu.analysis import jaxcheck, lockcheck, witness
+from katib_tpu.analysis.lint import (
+    BASELINE_DEFAULT,
+    load_baseline,
+    run_lint,
+    write_baseline,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def codes(findings):
+    return sorted(f.code for f in findings)
+
+
+def lock_findings(src):
+    return lockcheck.check_source(textwrap.dedent(src), "fixture.py")
+
+
+def jax_findings(src, timing=False):
+    return jaxcheck.check_source(textwrap.dedent(src), "fixture.py", timing=timing)
+
+
+# -- annotation grammar ------------------------------------------------------
+
+
+def test_guarded_by_returns_attr_to_lock_map():
+    assert G.guarded_by(_lock=("_a", "_b"), _other=("_c",)) == {
+        "_a": "_lock", "_b": "_lock", "_c": "_other"
+    }
+
+
+def test_guarded_by_rejects_empty_and_double_guarding():
+    with pytest.raises(ValueError):
+        G.guarded_by(_lock=())
+    with pytest.raises(ValueError):
+        G.guarded_by(_lock=("_a",), _other=("_a",))
+
+
+def test_parse_annotations_reads_suppressions_and_holds():
+    src = textwrap.dedent(
+        """
+        x = 1  # lint: unguarded-ok(wind-down only)
+        def f():  # lint: holds(_lock, _other)
+            pass
+        """
+    )
+    suppressed, holds = G.parse_annotations(src)
+    assert suppressed == {2: "wind-down only"}
+    assert holds == {3: ("_lock", "_other")}
+
+
+# -- LCK001: guarded access outside the lock ---------------------------------
+
+_LCK_FIXTURE = """
+    import threading
+    from katib_tpu.analysis import guarded_by
+
+    class Box:
+        _GUARDS = guarded_by(_lock=("_items",))
+
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = []            # __init__ is exempt
+
+        def good(self):
+            with self._lock:
+                return len(self._items)
+
+        def bad(self):
+            return len(self._items)     # LCK001
+
+        def waved(self):
+            return len(self._items)     # lint: unguarded-ok(test fixture)
+
+        def helper(self):  # lint: holds(_lock)
+            return len(self._items)
+"""
+
+
+def test_lck001_flags_only_the_bare_access():
+    findings = lock_findings(_LCK_FIXTURE)
+    assert codes(findings) == ["LCK001"]
+    (f,) = findings
+    assert f.symbol == "Box.bad"
+    assert f.detail == "_items"
+    assert "_lock" in f.message
+
+
+def test_lck001_multi_lock_class_tracks_each_lock():
+    findings = lock_findings(
+        """
+        class Engine:
+            _GUARDS = guarded_by(_queue_lock=("_ready",), _futures_lock=("futures",))
+
+            def wrong_lock(self):
+                with self._futures_lock:
+                    return list(self._ready)   # held the OTHER lock: LCK001
+
+            def right(self):
+                with self._queue_lock:
+                    with self._futures_lock:
+                        return list(self._ready) + list(self.futures)
+        """
+    )
+    assert codes(findings) == ["LCK001"]
+    assert findings[0].symbol == "Engine.wrong_lock"
+
+
+def test_lck001_nested_function_inherits_lexical_held_set():
+    findings = lock_findings(
+        """
+        class Box:
+            _GUARDS = guarded_by(_lock=("_items",))
+
+            def f(self):
+                with self._lock:
+                    def peek():
+                        return self._items  # lexically under the with: clean
+                    return peek()
+        """
+    )
+    assert findings == []
+
+
+# -- LCK002: escape to another thread ----------------------------------------
+
+
+def test_lck002_thread_and_executor_escapes():
+    findings = lock_findings(
+        """
+        import threading
+
+        class Box:
+            _GUARDS = guarded_by(_lock=("_items",))
+
+            def leak_thread(self):
+                t = threading.Thread(target=self._work, args=(self._items,))
+                t.start()
+
+            def leak_submit(self, pool):
+                return pool.submit(sum, self._items)
+        """
+    )
+    assert codes(findings) == ["LCK002", "LCK002"]
+    assert {f.symbol for f in findings} == {"Box.leak_thread", "Box.leak_submit"}
+
+
+def test_lck002_takes_precedence_over_lck001_on_the_same_node():
+    findings = lock_findings(
+        """
+        import threading
+
+        class Box:
+            _GUARDS = guarded_by(_lock=("_items",))
+
+            def leak(self):
+                threading.Thread(target=print, args=(self._items,)).start()
+        """
+    )
+    # one LCK002, and NOT an additional LCK001 for the same attribute node
+    assert codes(findings) == ["LCK002"]
+
+
+def test_lck002_suppression_silences_both_codes():
+    findings = lock_findings(
+        """
+        import threading
+
+        class Box:
+            _GUARDS = guarded_by(_lock=("_items",))
+
+            def leak(self):
+                threading.Thread(target=print, args=(self._items,)).start()  # lint: unguarded-ok(receiver is read-only)
+        """
+    )
+    assert findings == []
+
+
+# -- JAX101: host sync in a hot body -----------------------------------------
+
+
+def test_jax101_host_sync_in_scan_body():
+    findings = jax_findings(
+        """
+        import jax
+
+        def body(carry, x):
+            loss = float(carry)        # JAX101
+            return carry, x
+
+        def train(xs):
+            return jax.lax.scan(body, 0.0, xs)
+        """
+    )
+    assert codes(findings) == ["JAX101"]
+    assert findings[0].detail == "float()"
+    assert findings[0].symbol == "body"
+
+
+def test_jax101_loop_inside_jitted_fn_and_fori_body():
+    findings = jax_findings(
+        """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(xs):
+            out = []
+            for x in xs:
+                out.append(np.asarray(x))   # JAX101 (loop in jitted fn)
+            return out
+
+        def fbody(i, val):
+            return val + val.item()         # JAX101 (fori body)
+
+        def run(n, v0):
+            return jax.lax.fori_loop(0, n, fbody, v0)
+        """
+    )
+    assert codes(findings) == ["JAX101", "JAX101"]
+    assert {f.detail for f in findings} == {"np.asarray()", ".item()"}
+
+
+def test_jax101_clean_body_passes():
+    findings = jax_findings(
+        """
+        import jax
+
+        def body(carry, x):
+            return carry + x, x
+
+        def train(xs):
+            return jax.lax.scan(body, 0.0, xs)
+        """
+    )
+    assert findings == []
+
+
+# -- JAX102: jit constructed in a loop ---------------------------------------
+
+
+def test_jax102_jit_in_loop():
+    findings = jax_findings(
+        """
+        import jax
+
+        def sweep(fns, x):
+            outs = []
+            for fn in fns:
+                outs.append(jax.jit(fn)(x))   # JAX102
+            return outs
+        """
+    )
+    assert codes(findings) == ["JAX102"]
+    assert findings[0].symbol == "sweep"
+
+
+# -- JAX103: non-hashable static argument ------------------------------------
+
+
+def test_jax103_list_literal_at_static_position():
+    findings = jax_findings(
+        """
+        import jax
+
+        g = jax.jit(lambda shape, x: x, static_argnums=(0,))
+
+        def call(x):
+            return g([4, 4], x)            # JAX103
+
+        def direct(x):
+            return jax.jit(lambda s, x: x, static_argnums=(0,))({"k": 1}, x)  # JAX103
+        """
+    )
+    assert codes(findings) == ["JAX103", "JAX103"]
+
+
+def test_jax103_hashable_static_argument_passes():
+    findings = jax_findings(
+        """
+        import jax
+
+        g = jax.jit(lambda shape, x: x, static_argnums=(0,))
+
+        def call(x):
+            return g((4, 4), x)
+        """
+    )
+    assert findings == []
+
+
+# -- JAX104: donated-buffer reuse --------------------------------------------
+
+
+def test_jax104_read_after_donation():
+    findings = jax_findings(
+        """
+        import jax
+
+        step = jax.jit(lambda s: s, donate_argnums=(0,))
+
+        def train(state):
+            out = step(state)
+            return state                    # JAX104: donated buffer read
+        """
+    )
+    assert codes(findings) == ["JAX104"]
+    assert findings[0].detail == "state"
+
+
+def test_jax104_rebinding_revives_the_name():
+    findings = jax_findings(
+        """
+        import jax
+
+        step = jax.jit(lambda s: s, donate_argnums=(0,))
+
+        def train(state, n):
+            for _ in range(n):
+                state = step(state)         # rebind revives: clean
+            return state
+        """
+    )
+    assert findings == []
+
+
+# -- JAX105: unsynced timing boundary (bench files only) ---------------------
+
+
+def test_jax105_timer_without_sync():
+    src = """
+        import time
+
+        def bench(step, state):
+            t0 = time.perf_counter()
+            state = step(state)
+            elapsed = time.perf_counter() - t0   # JAX105: dispatch, not work
+            return elapsed
+    """
+    findings = jax_findings(src, timing=True)
+    assert codes(findings) == ["JAX105"]
+    assert findings[0].detail == "t0"
+    # the same source is NOT checked when the file isn't a bench entry point
+    assert jax_findings(src, timing=False) == []
+
+
+def test_jax105_block_until_ready_or_host_fetch_satisfies():
+    findings = jax_findings(
+        """
+        import jax, time
+
+        def bench_barrier(step, state):
+            t0 = time.perf_counter()
+            state = step(state)
+            jax.block_until_ready(state)
+            return time.perf_counter() - t0
+
+        def bench_fetch(step, state):
+            t0 = time.perf_counter()
+            loss = float(step(state))
+            return time.perf_counter() - t0
+        """,
+        timing=True,
+    )
+    assert findings == []
+
+
+# -- the runtime lock-order witness ------------------------------------------
+
+
+@pytest.fixture
+def witnessed(monkeypatch):
+    monkeypatch.setenv(witness.ENV_VAR, "1")
+    witness.witness_reset()
+    yield
+    witness.witness_reset()
+
+
+def test_make_lock_is_plain_lock_when_disabled(monkeypatch):
+    monkeypatch.delenv(witness.ENV_VAR, raising=False)
+    lk = witness.make_lock("test.plain")
+    assert not isinstance(lk, witness.WitnessLock)
+    with lk:
+        pass
+
+
+def test_witness_records_acquisition_graph(witnessed):
+    a = witness.make_lock("test.a")
+    b = witness.make_lock("test.b")
+    assert isinstance(a, witness.WitnessLock)
+    with a:
+        with b:
+            pass
+    snap = witness.witness_summary()
+    assert snap["acquires"] == {"test.a": 1, "test.b": 1}
+    assert ("test.a", "test.b", 1) in snap["edges"]
+    assert witness.witness_cycles() == []
+
+
+def test_witness_raises_on_lock_order_inversion(witnessed):
+    a = witness.make_lock("test.a")
+    b = witness.make_lock("test.b")
+    with a:
+        with b:
+            pass
+    with b:
+        with pytest.raises(witness.LockOrderInversion):
+            a.acquire()
+    # the inversion was recorded for the soak report, and the failed
+    # acquire did NOT take the lock (raise-before-acquire)
+    assert witness.witness_cycles()
+    assert not a.locked()
+
+
+def test_witness_same_role_reacquisition_records_no_edge(witnessed):
+    # two instances of one role (every _Metric._lock shares "metrics.metric"):
+    # nesting them must not self-edge, and must not poison later ordering
+    m1 = witness.make_lock("test.metric")
+    m2 = witness.make_lock("test.metric")
+    with m1:
+        with m2:
+            pass
+    assert witness.witness_summary()["edges"] == []
+    assert witness.witness_cycles() == []
+
+
+def test_witness_transitive_inversion_detected(witnessed):
+    a = witness.make_lock("test.a")
+    b = witness.make_lock("test.b")
+    c = witness.make_lock("test.c")
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with c:
+        with pytest.raises(witness.LockOrderInversion):
+            with a:
+                pass
+
+
+# -- lint driver: baseline ratchet + repo gate -------------------------------
+
+_DIRTY_MODULE = textwrap.dedent(
+    """
+    from katib_tpu.analysis import guarded_by
+
+    class Box:
+        _GUARDS = guarded_by(_lock=("_items",))
+
+        def bad(self):
+            return len(self._items)
+    """
+)
+
+
+def _mini_repo(tmp_path, dirty=True):
+    pkg = tmp_path / "katib_tpu"
+    pkg.mkdir()
+    (pkg / "box.py").write_text(_DIRTY_MODULE if dirty else "x = 1\n")
+    return str(tmp_path)
+
+
+def test_run_lint_fails_on_new_finding(tmp_path):
+    report = run_lint(root=_mini_repo(tmp_path))
+    assert report.exit_code == 1
+    assert codes(report.new) == ["LCK001"]
+    assert report.baselined == []
+
+
+def test_baseline_ratchet_accepts_then_reports_stale(tmp_path):
+    root = _mini_repo(tmp_path)
+    baseline = os.path.join(root, "baseline.json")
+    report = run_lint(root=root)
+    write_baseline(baseline, report.findings)
+
+    # baselined: same findings, exit 0
+    again = run_lint(root=root, baseline_path=baseline)
+    assert again.exit_code == 0
+    assert codes(again.baselined) == ["LCK001"]
+    assert again.new == []
+
+    # fingerprints are line-number-free: moving the code keeps the ratchet
+    (tmp_path / "katib_tpu" / "box.py").write_text("\n\n\n" + _DIRTY_MODULE)
+    moved = run_lint(root=root, baseline_path=baseline)
+    assert moved.exit_code == 0 and moved.new == []
+
+    # fixing the finding leaves a stale entry the report names for pruning
+    (tmp_path / "katib_tpu" / "box.py").write_text("x = 1\n")
+    fixed = run_lint(root=root, baseline_path=baseline)
+    assert fixed.exit_code == 0
+    assert len(fixed.stale_baseline) == 1
+    assert fixed.stale_baseline[0].startswith("LCK001:")
+
+
+def test_cli_lint_verb_exit_codes(tmp_path, capsys):
+    from katib_tpu.cli import main
+
+    root = _mini_repo(tmp_path)
+    assert main(["lint", "--root", root]) == 1
+    assert "LCK001" in capsys.readouterr().out
+
+    baseline = os.path.join(root, "artifacts", "lint", "baseline.json")
+    assert main(["lint", "--root", root, "--update-baseline"]) == 0
+    doc = json.loads(open(baseline).read())
+    assert doc["findings"] and doc["findings"][0].startswith("LCK001:")
+    assert main(["lint", "--root", root]) == 0
+
+
+def test_repo_is_lint_clean_against_committed_baseline():
+    """The acceptance gate: ``katib-tpu lint`` exits 0 at HEAD.  Every true
+    positive was fixed, not baselined — the committed baseline is empty."""
+    baseline = os.path.join(REPO_ROOT, BASELINE_DEFAULT)
+    report = run_lint(root=REPO_ROOT, baseline_path=baseline)
+    assert report.new == [], "\n".join(f.render() for f in report.new)
+    assert load_baseline(baseline) == []
+    assert report.stale_baseline == []
+    assert report.files_scanned > 50
