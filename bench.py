@@ -8,8 +8,9 @@ and prints ONE JSON line.
 Reported numbers:
 - ``value``: images/sec through the full bilevel step (arch + weight update);
 - ``mfu``: model-FLOPs utilisation — XLA's own per-step flop count
-  (``compiled.cost_analysis()``) divided by the chip's peak
-  (v5e ≈ 197 TFLOP/s bf16 / 98.5 TFLOP/s fp32); self-contained and
+  (``katib_tpu.costmodel`` CostRecord) divided by the chip's peak from
+  the per-device-kind table (``katib_tpu/costmodel/peaks.py``; v5e ≈
+  197 TFLOP/s bf16 / 98.5 TFLOP/s fp32); self-contained and
   hardware-honest, unlike a cross-vendor img/s ratio;
 - ``vs_baseline``: img/s against the reference PyTorch trial image running
   the same second-order search on its CI GPU class (~250 img/s on a
@@ -113,14 +114,9 @@ WARMUP_STEPS = 1 if _SMALL else 2
 TIMED_STEPS = max(1, int(os.environ.get("BENCH_STEPS", "3" if _SMALL else "20")))
 
 REFERENCE_IMG_PER_SEC = 250.0
-# peak dense matmul throughput per chip; MFU denominator
-PEAK_FLOPS = {
-    ("v5e", "bf16"): 197e12,
-    ("v5e", "f32"): 98.5e12,
-}
-# roofline constants for the AOT compile-only block (v5e datasheet)
-V5E_HBM_BYTES = 16 * 1024**3
-V5E_HBM_BW = 819e9  # bytes/s
+# peak flops / HBM bandwidth now come from the shared per-device-kind
+# table (katib_tpu/costmodel/peaks.py) — KATIB_PEAK_FLOPS/KATIB_PEAK_BW
+# override them for hardware the table doesn't know
 _RESULT_TAG = "@@BENCH_RESULT@@"
 
 
@@ -213,8 +209,10 @@ def _aot_child() -> None:
     """
     import jax
     import jax.numpy as jnp
-    from jax.experimental import topologies
-    from jax.sharding import SingleDeviceSharding
+
+    from katib_tpu.costmodel import aot as cm_aot
+    from katib_tpu.costmodel import peaks as cm_peaks
+    from katib_tpu.costmodel.record import CostRecord, cost_of_compiled
 
     jax.config.update("jax_platforms", "cpu")  # host math only; TPU is a target
     # persist the executable: the full-size TPU-target compile runs ~27 min
@@ -226,41 +224,23 @@ def _aot_child() -> None:
     except Exception:
         pass
     t0 = time.perf_counter()
-    topo = topologies.get_topology_desc(
-        platform="tpu",
-        topology_name="v5e:1x1x1",
-        chips_per_host_bounds=(1, 1, 1),
-        num_slices=1,
-    )
-    dev = topo.devices[0]
+    dev = cm_aot.topology_device("v5e:1x1x1")
     topo_secs = time.perf_counter() - t0  # lint: unguarded-ok(deviceless AOT: topology lookup is host-only, no program dispatched)
 
     step, state, batch, net, remat = _build_flagship(jax, jnp)
-    place = lambda a: jax.ShapeDtypeStruct(  # noqa: E731
-        a.shape, a.dtype, sharding=SingleDeviceSharding(dev)
-    )
-    state_s, batch_s = jax.tree.map(place, (state, batch))
+    compiled, compile_secs = cm_aot.aot_compile(step, (state, batch, batch), dev)
 
-    t0 = time.perf_counter()
-    compiled = jax.jit(step).lower(state_s, batch_s, batch_s).compile()
-    compile_secs = time.perf_counter() - t0  # lint: unguarded-ok(deviceless AOT: client-side compile is synchronous host work)
-
-    cost = compiled.cost_analysis()
-    if isinstance(cost, (list, tuple)):
-        cost = cost[0] if cost else {}
-    flops = float(cost.get("flops", 0.0))
-    bytes_accessed = float(cost.get("bytes accessed", 0.0))
-    mem = compiled.memory_analysis()
-    hbm_bytes = int(
-        mem.argument_size_in_bytes
-        + mem.output_size_in_bytes
-        + mem.temp_size_in_bytes
-        + mem.generated_code_size_in_bytes
-    )
     dtype_key = "bf16" if net.dtype == jnp.bfloat16 else "f32"
-    peak = PEAK_FLOPS[("v5e", dtype_key)]
-    compute_secs = flops / peak if flops else 0.0
-    memory_secs = bytes_accessed / V5E_HBM_BW if bytes_accessed else 0.0
+    rec = cost_of_compiled(compiled, program="bench.aot", dtype=dtype_key)
+    if rec is None:  # cost analysis is backend-dependent; keep the report
+        rec = CostRecord(program="bench.aot", dtype=dtype_key)
+    peaks = cm_peaks.peaks_for("v5e")
+    roof = rec.roofline(peaks)
+    flops = rec.flops
+    bytes_accessed = rec.bytes_accessed
+    hbm_bytes = rec.hbm_bytes
+    compute_secs = roof["compute_floor_step_secs"]
+    memory_secs = roof["prefusion_bw_step_secs"]
     print(
         _RESULT_TAG
         + json.dumps(
@@ -271,7 +251,7 @@ def _aot_child() -> None:
                 "bytes_accessed": bytes_accessed,
                 "hbm_bytes": hbm_bytes,
                 "hbm_gib": round(hbm_bytes / 1024**3, 3),
-                "hbm_fits_v5e": hbm_bytes < V5E_HBM_BYTES,
+                "hbm_fits_v5e": hbm_bytes < peaks.hbm_bytes,
                 "dtype": dtype_key,
                 # step-time band, not a point estimate: the compute floor
                 # assumes MFU=1; the bandwidth figure charges XLA's
@@ -678,7 +658,14 @@ def _child() -> None:
     # microseconds while the chip is still working, which once inflated
     # this benchmark 93x (5.8 ms/step reported vs 539 ms/step measured by
     # a host-fetch-forced probe AND by the flagship run's epoch math).
+    from katib_tpu.costmodel.record import cost_of_compiled
+
     runner = jax.jit(step)
+    # MFU numerator/denominator dtypes must match the COMPUTE dtype (the
+    # supernet casts to its flax compute dtype internally — f32 inputs
+    # still run bf16 matmuls)
+    dtype_key = "bf16" if net.dtype == jnp.bfloat16 else "f32"
+    cost_rec = None
     flops_per_step = 0.0
     compile_secs = 0.0
     try:
@@ -686,10 +673,10 @@ def _child() -> None:
         t_c0 = time.perf_counter()
         compiled = lowered.compile()
         compile_secs = time.perf_counter() - t_c0  # lint: unguarded-ok(client-side compile is synchronous host work)
-        cost = compiled.cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0] if cost else {}
-        flops_per_step = float(cost.get("flops", 0.0)) if cost else 0.0
+        cost_rec = cost_of_compiled(
+            compiled, program="bench.step", dtype=dtype_key
+        )
+        flops_per_step = cost_rec.flops_per_step if cost_rec is not None else 0.0
     except Exception as e:  # cost analysis is backend-dependent
         print(f"bench: cost analysis unavailable ({e})", file=sys.stderr)
 
@@ -765,12 +752,11 @@ def _child() -> None:
     loop_steps = loop_window * loop_dispatches
     loop_img_per_sec = BATCH * loop_steps / loop_dt
     loop_step_secs = loop_dt / loop_steps
+    from katib_tpu.costmodel.peaks import peaks_for
+
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
-    # MFU denominator must match the COMPUTE dtype (the supernet casts to
-    # its flax compute dtype internally — f32 inputs still run bf16 matmuls)
-    dtype_key = "bf16" if net.dtype == jnp.bfloat16 else "f32"
-    peak = PEAK_FLOPS.get((gen, dtype_key), PEAK_FLOPS[("v5e", dtype_key)])
-    mfu = (flops_per_step / step_secs) / peak if flops_per_step else 0.0
+    peaks = peaks_for(gen)  # unknown generations fall back to v5e
+    mfu = cost_rec.mfu(step_secs, peaks) if cost_rec is not None else 0.0
     fused_note = (
         {
             "flops_note": (
@@ -807,8 +793,8 @@ def _child() -> None:
                     "dispatches": loop_dispatches,
                     "compile_secs": round(loop_compile_secs, 1),
                     "mfu": round(
-                        (flops_per_step / loop_step_secs) / peak
-                        if flops_per_step
+                        cost_rec.mfu(loop_step_secs, peaks)
+                        if cost_rec is not None
                         else 0.0,
                         6,
                     ),

@@ -165,6 +165,24 @@ def cmd_run(args: argparse.Namespace) -> int:
     return 0 if exp.condition.value != "Failed" else 1
 
 
+def _pinned_structural(spec) -> dict:
+    """Parameters pinned to a single structural value — the shapes that
+    join a prewarm/cost signature; everything else rides the workload's
+    own defaults (exactly what an unpinned sweep's signature carries at
+    run time; unstepped doubles are runtime operands, not shapes)."""
+    from katib_tpu.compile.registry import _structural
+
+    shared = {}
+    for p in spec.parameters:
+        try:
+            vals = p.grid_values()
+        except Exception:
+            continue
+        if len(vals) == 1 and _structural(vals[0]):
+            shared[p.name] = vals[0]
+    return shared
+
+
 def cmd_prewarm(args: argparse.Namespace) -> int:
     """Compile an experiment's programs into the persistent cache ahead of a
     run: the fleet analog of the orchestrator's in-run prewarm worker.  Runs
@@ -176,7 +194,7 @@ def cmd_prewarm(args: argparse.Namespace) -> int:
         PrewarmWorker,
         prewarm_fn_of,
     )
-    from katib_tpu.compile.registry import REGISTRY, _structural
+    from katib_tpu.compile.registry import REGISTRY
     from katib_tpu.runner.cohort import cohort_fn_of
     from katib_tpu.runner.trial_runner import init_compile_cache
     from katib_tpu.sdk.yaml_spec import load_experiment_yaml
@@ -196,17 +214,7 @@ def cmd_prewarm(args: argparse.Namespace) -> int:
             "KATIB_COMPILE_CACHE) — prewarming helps only this process",
             file=sys.stderr,
         )
-    # shapes: parameters pinned to a single structural value join the
-    # signature; everything else rides the workload's own defaults (exactly
-    # what an unpinned sweep's signature carries at run time)
-    shared = {}
-    for p in spec.parameters:
-        try:
-            vals = p.grid_values()
-        except Exception:
-            continue  # unstepped double: runtime operand, not a shape
-        if len(vals) == 1 and _structural(vals[0]):
-            shared[p.name] = vals[0]
+    shared = _pinned_structural(spec)
     cohort_fn = cohort_fn_of(spec.train_fn)
     if args.widths:
         widths = sorted({max(1, int(w)) for w in args.widths.split(",")})
@@ -250,6 +258,192 @@ def cmd_prewarm(args: argparse.Namespace) -> int:
     if rows:
         print(_table(rows, ["program", "k", "source", "compile_s"]))
     return 0 if worker.failed == 0 and done else 1
+
+
+def _read_registry_dir(d: str) -> list[dict]:
+    """Fold ``shape_registry.jsonl`` rows under ``d`` (a compile-cache dir,
+    or a workdir with cache dirs one level down) — same first-record-wins /
+    latest-cost-wins merge the live registry applies."""
+    import glob as _glob
+    import json as _json
+
+    from katib_tpu.compile.registry import _REGISTRY_FILENAME
+
+    paths = [os.path.join(d, _REGISTRY_FILENAME)]
+    paths += sorted(_glob.glob(os.path.join(d, "*", _REGISTRY_FILENAME)))
+    by_key: dict[str, dict] = {}
+    for path in paths:
+        try:
+            with open(path, errors="replace") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = _json.loads(line)
+                    except ValueError:
+                        continue
+                    if not isinstance(rec, dict) or not rec.get("key"):
+                        continue
+                    cur = by_key.setdefault(rec["key"], rec)
+                    if cur is not rec and isinstance(rec.get("cost"), dict):
+                        cur["cost"] = rec["cost"]
+        except OSError:
+            continue
+    return list(by_key.values())
+
+
+def cmd_cost(args: argparse.Namespace) -> int:
+    """Deviceless roofline table: each compiled program's XLA cost record
+    (shape registry) joined against the device-kind peaks table — flops
+    and bytes per step, arithmetic intensity, which roofline (compute or
+    HBM bandwidth) binds, the floor step time, and the MFU ceiling.  No
+    TPU needed: given a YAML with nothing costed yet, the experiment's
+    prewarm twins run in-process and observe the cost as a side effect."""
+    from katib_tpu import costmodel
+
+    target = args.target
+    if os.path.isdir(target):
+        recs = _read_registry_dir(target)
+    else:
+        from katib_tpu.compile.buckets import bucket_size
+        from katib_tpu.compile.prewarm import (
+            PrewarmRequest,
+            PrewarmWorker,
+            prewarm_fn_of,
+        )
+        from katib_tpu.compile.registry import REGISTRY
+        from katib_tpu.runner.cohort import cohort_fn_of
+        from katib_tpu.runner.trial_runner import init_compile_cache
+        from katib_tpu.sdk.yaml_spec import load_experiment_yaml
+
+        spec = load_experiment_yaml(target)
+        init_compile_cache(spec.compile_cache)
+        recs = REGISTRY.signatures()
+        needs_warm = not any(isinstance(r.get("cost"), dict) for r in recs)
+        if needs_warm and spec.train_fn is not None and prewarm_fn_of(spec.train_fn):
+            cohort_fn = cohort_fn_of(spec.train_fn)
+            widths = {1}
+            if spec.cohort_width > 1 and cohort_fn is not None:
+                for size in range(2, spec.cohort_width + 1):
+                    widths.add(bucket_size(size) if spec.cohort_buckets else size)
+            worker = PrewarmWorker()
+            for k in sorted(widths):
+                worker.submit(
+                    PrewarmRequest(
+                        train_fn=spec.train_fn,
+                        shared=_pinned_structural(spec),
+                        k=k,
+                        program_fn=cohort_fn if k > 1 else None,
+                    )
+                )
+            worker.drain(timeout=args.timeout)
+            worker.stop()
+            recs = REGISTRY.signatures()
+    costed = [r for r in recs if isinstance(r.get("cost"), dict)]
+    if not costed:
+        print(
+            "no cost records on file — run the experiment (or `katib-tpu "
+            "prewarm`) with a persistent compile cache first, or point at "
+            "an experiment YAML whose train_fn has a prewarm twin",
+            file=sys.stderr,
+        )
+        return 1
+    pk = costmodel.peaks_for(args.device)
+    print(
+        f"roofline vs {pk.device_kind}: "
+        f"{pk.peak_flops('bf16') / 1e12:.1f} TFLOP/s bf16 peak, "
+        f"{pk.hbm_bandwidth / 1e9:.0f} GB/s HBM, "
+        f"ridge {pk.ridge_intensity:.0f} flops/byte "
+        "(bytes are pre-fusion: floors are lower bounds, max_mfu an upper bound)"
+    )
+    rows = []
+    for r in sorted(costed, key=lambda r: (str(r.get("program")), int(r.get("k", 1)))):
+        rec = costmodel.CostRecord.from_dict(r["cost"])
+        roof = rec.roofline(pk)
+        rows.append(
+            [
+                r.get("program", "?"),
+                r.get("k", 1),
+                r.get("mesh", "") or "-",
+                f"{rec.flops_per_step / 1e9:.3f}",
+                f"{rec.bytes_per_step / 1e6:.2f}",
+                f"{roof['arithmetic_intensity']:.1f}",
+                roof["bound"].replace("-bound", ""),
+                f"{roof['floor_step_secs'] * 1e3:.3f}",
+                f"{roof['max_mfu']:.2f}",
+                f"{rec.hbm_bytes / 2**30:.2f}" if rec.hbm_bytes else "-",
+            ]
+        )
+    print(
+        _table(
+            rows,
+            [
+                "program", "k", "mesh", "gflop/step", "mb/step", "ai",
+                "bound", "floor_ms", "max_mfu", "hbm_gb",
+            ],
+        )
+    )
+    return 0
+
+
+def cmd_profile(args: argparse.Namespace) -> int:
+    """On-demand ``jax.profiler`` capture + the capture inventory.
+
+    ``--list`` discovers past captures under a workdir (per-trial
+    ``enable_profiler`` directories and ``profile.capture`` spans in the
+    trace journals).  With an experiment YAML it runs the experiment's
+    prewarm twin under the profiler — an xprof trace of the exact
+    compiled program, without scheduling a trial."""
+    from katib_tpu.costmodel import profiler as costprofiler
+
+    if args.list:
+        entries = costprofiler.scan_profiles(args.workdir)
+        if not entries:
+            print(f"no profiler captures under {args.workdir}")
+            return 0
+        rows = [
+            [
+                e.get("experiment") or "-",
+                e.get("trial") or "-",
+                e.get("source", "-"),
+                e.get("trace_dir", "?"),
+            ]
+            for e in entries
+        ]
+        print(_table(rows, ["experiment", "trial", "source", "trace_dir"]))
+        return 0
+    if not args.experiment:
+        print(
+            "error: pass an experiment YAML to capture, or --list to "
+            "inventory past captures",
+            file=sys.stderr,
+        )
+        return 2
+    from katib_tpu.compile.prewarm import prewarm_fn_of
+    from katib_tpu.runner.trial_runner import init_compile_cache
+    from katib_tpu.sdk.yaml_spec import load_experiment_yaml
+
+    spec = load_experiment_yaml(args.experiment)
+    fn = prewarm_fn_of(spec.train_fn)
+    if fn is None:
+        print(
+            "error: the experiment's train_fn declares no prewarm twin to "
+            "profile (see katib_tpu.compile.prewarm.attach_prewarm_fn)",
+            file=sys.stderr,
+        )
+        return 2
+    init_compile_cache(spec.compile_cache)
+    # default lands on the <workdir>/<experiment>/<trial>/profile layout
+    # enable_profiler trials use, so `profile --list` discovers it
+    out = args.out or os.path.join(args.workdir, spec.name, "adhoc", "profile")
+    with costprofiler.capture(out, trial="adhoc", experiment=spec.name):
+        fn(dict(_pinned_structural(spec)), 1, None)
+    print(
+        f"profiler trace: {out} (load with TensorBoard's profile plugin "
+        "or xprof; listed by `katib-tpu profile --list`)"
+    )
+    return 0
 
 
 def cmd_list(args: argparse.Namespace) -> int:
@@ -1197,8 +1391,10 @@ def cmd_trace_summary(args: argparse.Namespace) -> int:
         print(f"no spans found at {journal}", file=sys.stderr)
         return 1
     summary = tracing.summarize(records)
+    slowest = _slowest_spans(records, args.top) if args.top else []
     if args.json:
-        _json.dump(summary, sys.stdout, indent=2)
+        doc = {"summary": summary, "slowest": slowest} if args.top else summary
+        _json.dump(doc, sys.stdout, indent=2)
         print()
         return 0
     rows = [
@@ -1214,7 +1410,51 @@ def cmd_trace_summary(args: argparse.Namespace) -> int:
         for s in summary
     ]
     print(_table(rows, ["SPAN", "COUNT", "TOTAL_S", "MEAN_S", "P50_S", "P95_S", "MAX_S"]))
+    if slowest:
+        rows = [
+            [
+                s["name"],
+                f"{s['dur_s']:.3f}",
+                s["who"],
+                s["mfu"],
+                s["roofline"],
+                s["headroom"],
+            ]
+            for s in slowest
+        ]
+        print(f"\nslowest {len(rows)} spans (roofline attrs where costed):")
+        print(_table(rows, ["SPAN", "DUR_S", "WHO", "MFU", "ROOFLINE", "HEADROOM"]))
     return 0
+
+
+def _slowest_spans(records: list[dict], top: int) -> list[dict]:
+    """The ``--top N`` view: individual spans by duration, surfacing the
+    roofline attrs (``costmodel.publish_dispatch``) stamped on
+    trial/cohort/darts.epoch spans — a slow span with low MFU and high
+    headroom is leaving the accelerator idle, not compute-starved."""
+
+    def _dur(rec: dict) -> float:
+        try:
+            return float(rec.get("dur", 0.0))
+        except (TypeError, ValueError):
+            return 0.0
+
+    out = []
+    for rec in sorted(records, key=_dur, reverse=True)[: max(0, top)]:
+        args = rec.get("args", {}) or {}
+        mfu = args.get("mfu")
+        who = args.get("trial") or args.get("cohort") or args.get("epoch")
+        out.append(
+            {
+                "name": str(rec.get("name", "?")),
+                "dur_s": round(_dur(rec), 6),
+                "who": str(who) if who is not None else "-",
+                "mfu": f"{mfu:.4f}" if isinstance(mfu, (int, float)) else "-",
+                "roofline": str(args.get("roofline", "-")),
+                "headroom": str(args.get("roofline_headroom", "-")),
+            }
+        )
+    return out
 
 
 def cmd_db_manager(args: argparse.Namespace) -> int:
@@ -1525,7 +1765,62 @@ def main(argv: list[str] | None = None) -> int:
     tp.add_argument("experiment")
     tp.add_argument("--workdir", default="katib_runs")
     tp.add_argument("--json", action="store_true")
+    tp.add_argument(
+        "--top",
+        type=int,
+        default=0,
+        metavar="N",
+        help="also list the N slowest individual spans with their roofline "
+        "attrs (mfu / bound / headroom)",
+    )
     tp.set_defaults(fn=cmd_trace_summary)
+
+    p = sub.add_parser(
+        "cost",
+        help="deviceless roofline table from the shape registry's XLA cost records",
+    )
+    p.add_argument(
+        "target",
+        help="experiment YAML (compiles the prewarm twins if nothing is "
+        "costed yet) or a compile-cache/workdir directory holding "
+        "shape_registry.jsonl",
+    )
+    p.add_argument(
+        "--device",
+        default=None,
+        help="device kind for the peaks table (v5e/v5p/v4/v3/cpu; default: "
+        "detect, honoring PALLAS_AXON_TPU_GEN and KATIB_PEAK_* overrides)",
+    )
+    p.add_argument(
+        "--timeout",
+        type=float,
+        default=300.0,
+        help="prewarm-twin compile budget in seconds (YAML targets only)",
+    )
+    p.set_defaults(fn=cmd_cost)
+
+    p = sub.add_parser(
+        "profile",
+        help="on-demand jax.profiler capture (or --list past captures)",
+    )
+    p.add_argument(
+        "experiment",
+        nargs="?",
+        default=None,
+        help="experiment YAML whose prewarm twin to run under the profiler",
+    )
+    p.add_argument("--workdir", default="katib_runs")
+    p.add_argument(
+        "--out",
+        default=None,
+        help="trace output dir (default <workdir>/<experiment>/adhoc/profile)",
+    )
+    p.add_argument(
+        "--list",
+        action="store_true",
+        help="inventory captures under --workdir instead of capturing",
+    )
+    p.set_defaults(fn=cmd_profile)
 
     p = sub.add_parser("conformance", help="packaged e2e invariants check")
     p.add_argument("--max-trials", type=int, default=8)

@@ -158,6 +158,9 @@ class PrewarmWorker:
             return
         import time
 
+        from katib_tpu import costmodel
+
+        costmodel.clear_active()  # worker thread is reused across requests
         started = time.perf_counter()
         fn(dict(req.shared), int(req.k), req.mesh)
         elapsed = time.perf_counter() - started
@@ -165,6 +168,15 @@ class PrewarmWorker:
             with self._lock:  # LCK001: counter read from the caller thread
                 self.compiled += 1
             obs.prewarm_compiles.inc(program=sig.program)
+        # twins observe their program cost into the ambient slot
+        # (costmodel.observe_program) — persist it next to the signature so
+        # `katib-tpu cost` can print the roofline table without a run
+        active = costmodel.active_cost()
+        if active is not None:
+            try:
+                self._registry.record_cost(sig, active[0].as_dict())
+            except Exception:
+                pass  # cost is telemetry; the prewarm itself succeeded
 
     def drain(self, timeout: float = 30.0) -> bool:
         """Wait (bounded) for the queue to empty — CLI verb / tests only;

@@ -218,7 +218,12 @@ class ShapeRegistry:
                     valid_end = offset
                     key = rec.get("key") if isinstance(rec, dict) else None
                     if key:
-                        self._seen.setdefault(key, rec)
+                        cur = self._seen.setdefault(key, rec)
+                        if cur is not rec and isinstance(rec.get("cost"), dict):
+                            # first record wins for identity fields, but a
+                            # later cost-bearing line (record_cost re-appends
+                            # the row) carries the freshest XLA analysis
+                            cur["cost"] = rec["cost"]
                 if torn:
                     import warnings
 
@@ -287,6 +292,44 @@ class ShapeRegistry:
                 # it used to run after the lock was dropped
                 self._append(rec)
         return fresh
+
+    def record_cost(self, sig: CompileSignature, cost: Mapping[str, Any]) -> bool:
+        """Merge an XLA cost record (``costmodel.CostRecord.as_dict()``)
+        into the signature's row and re-append it so registry-sharing
+        processes (and ``katib-tpu cost``) see the analysis.  Idempotent:
+        an unchanged cost neither rewrites memory nor grows the file.
+        Returns True when the row changed."""
+        key = sig.key()
+        cost = dict(cost)
+        with self._lock:
+            self._maybe_load()
+            rec = self._seen.get(key)
+            if rec is None:
+                # cost can arrive before record() (e.g. a model observing
+                # its program mid-first-epoch) — synthesize the row
+                rec = {
+                    "key": key,
+                    "program": sig.program,
+                    "k": sig.k,
+                    "mesh": sig.mesh,
+                    "shapes": dict(sig.shapes),
+                    "donation": sig.donation,
+                    "source": "cost",
+                }
+                self._seen[key] = rec
+            if rec.get("cost") == cost:
+                return False
+            rec["cost"] = cost
+            self._append(rec)
+        return True
+
+    def cost_of(self, sig: CompileSignature) -> dict | None:
+        """The persisted cost record for a signature, or None."""
+        with self._lock:
+            self._maybe_load()
+            rec = self._seen.get(sig.key())
+        cost = rec.get("cost") if isinstance(rec, dict) else None
+        return dict(cost) if isinstance(cost, dict) else None
 
     def classify(self, sig: CompileSignature) -> str:
         """``"warm"`` when the signature was compiled before (this process

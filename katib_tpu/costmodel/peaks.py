@@ -1,0 +1,160 @@
+"""Per-device-kind peak flops / HBM bandwidth tables — the MFU denominator.
+
+``bench.py`` used to hardcode a single v5e datasheet entry; moving the
+table here makes MFU meaningful on v5p/v4/v3 and on CPU dev boxes, and
+gives operators an escape hatch for hardware the table doesn't know:
+
+- ``KATIB_PEAK_FLOPS`` — peak dense flops/s per chip (every dtype)
+- ``KATIB_PEAK_BW``    — peak HBM bandwidth, bytes/s
+
+Datasheet sources: TPU v5e/v5p/v4/v3 public specs (per-chip dense
+matmul peak; f32 at half the bf16 rate on generations without an f32
+MXU path).  The ``cpu`` entry is a deliberately round nominal figure so
+development runs publish *non-null* gauges — CPU MFU is an ordering
+signal, not an absolute one.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class DevicePeaks:
+    """Peak throughput of one device kind (per chip)."""
+
+    device_kind: str
+    flops: dict[str, float] = field(default_factory=dict)  # dtype -> flops/s
+    hbm_bandwidth: float = 0.0  # bytes/s
+    hbm_bytes: int = 0
+
+    def peak_flops(self, dtype: str = "bf16") -> float:
+        """Peak for ``dtype``, falling back bf16 -> best known (a missing
+        dtype must yield a denominator, not a KeyError mid-trial)."""
+        v = self.flops.get(dtype)
+        if v is None:
+            v = self.flops.get("bf16")
+        if v is None and self.flops:
+            v = max(self.flops.values())
+        return float(v or 0.0)
+
+    @property
+    def ridge_intensity(self) -> float:
+        """Arithmetic intensity (flops/byte) where the compute and
+        bandwidth roofs cross — programs below it are memory-bound."""
+        if not self.hbm_bandwidth:
+            return 0.0
+        return self.peak_flops() / self.hbm_bandwidth
+
+
+PEAKS: dict[str, DevicePeaks] = {
+    "v5e": DevicePeaks(
+        "v5e",
+        {"bf16": 197e12, "f32": 98.5e12, "int8": 394e12},
+        hbm_bandwidth=819e9,
+        hbm_bytes=16 * 1024**3,
+    ),
+    "v5p": DevicePeaks(
+        "v5p",
+        {"bf16": 459e12, "f32": 229.5e12, "int8": 918e12},
+        hbm_bandwidth=2765e9,
+        hbm_bytes=95 * 1024**3,
+    ),
+    "v4": DevicePeaks(
+        "v4",
+        {"bf16": 275e12, "f32": 137.5e12},
+        hbm_bandwidth=1228e9,
+        hbm_bytes=32 * 1024**3,
+    ),
+    "v3": DevicePeaks(
+        "v3",
+        {"bf16": 123e12, "f32": 61.5e12},
+        hbm_bandwidth=900e9,
+        hbm_bytes=32 * 1024**3,
+    ),
+    # nominal dev-box figure: keeps CPU runs publishing non-null MFU
+    # gauges; treat CPU MFU as relative, not absolute
+    "cpu": DevicePeaks(
+        "cpu",
+        {"bf16": 2e11, "f32": 2e11},
+        hbm_bandwidth=5e10,
+        hbm_bytes=16 * 1024**3,
+    ),
+}
+
+_DEFAULT_KIND = "v5e"  # the pool this repo targets; unknown TPUs assume it
+
+
+def normalize_device_kind(kind: str | None) -> str:
+    """Fold a raw ``Device.device_kind`` / platform string onto a table
+    key: ``"TPU v5 lite"`` -> ``v5e``, ``"TPU v4"`` -> ``v4``, anything
+    CPU-ish -> ``cpu``, unknown TPU kinds -> the default generation."""
+    if not kind:
+        return _DEFAULT_KIND
+    k = str(kind).strip().lower()
+    if "cpu" in k:
+        return "cpu"
+    if "v5 lite" in k or "v5lite" in k or "v5e" in k:
+        return "v5e"
+    if "v5p" in k or k == "tpu v5" or k == "v5":
+        return "v5p"
+    if "v4" in k:
+        return "v4"
+    if "v3" in k:
+        return "v3"
+    return k if k in PEAKS else _DEFAULT_KIND
+
+
+def detect_device_kind() -> str:
+    """Best-effort device kind of the live backend.  ``PALLAS_AXON_TPU_GEN``
+    wins (the axon relay's devices self-report generically); falls back
+    to ``jax.devices()[0]`` and, with no backend at all, ``cpu``."""
+    env = os.environ.get("PALLAS_AXON_TPU_GEN")
+    if env:
+        return normalize_device_kind(env)
+    try:
+        import jax
+
+        d = jax.devices()[0]
+        if d.platform != "tpu":
+            return normalize_device_kind(d.platform)
+        return normalize_device_kind(getattr(d, "device_kind", None))
+    except Exception:
+        return "cpu"
+
+
+def _env_float(name: str) -> float | None:
+    raw = os.environ.get(name)
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        return None
+
+
+def peaks_for(device_kind: str | None = None) -> DevicePeaks:
+    """The peaks entry for ``device_kind`` (detected when None), with the
+    ``KATIB_PEAK_FLOPS`` / ``KATIB_PEAK_BW`` env overrides applied."""
+    kind = (
+        normalize_device_kind(device_kind)
+        if device_kind is not None
+        else detect_device_kind()
+    )
+    entry = PEAKS.get(kind, PEAKS[_DEFAULT_KIND])
+    flops_ov = _env_float("KATIB_PEAK_FLOPS")
+    bw_ov = _env_float("KATIB_PEAK_BW")
+    if flops_ov is None and bw_ov is None:
+        return entry
+    flops = (
+        {k: flops_ov for k in (entry.flops or {"bf16": 0.0})}
+        if flops_ov is not None
+        else dict(entry.flops)
+    )
+    return DevicePeaks(
+        device_kind=entry.device_kind,
+        flops=flops,
+        hbm_bandwidth=bw_ov if bw_ov is not None else entry.hbm_bandwidth,
+        hbm_bytes=entry.hbm_bytes,
+    )

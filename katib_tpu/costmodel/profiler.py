@@ -1,0 +1,120 @@
+"""On-demand ``jax.profiler`` capture + the profile-directory registry.
+
+``enable_profiler`` runs used to leave their trace directories invisible
+after the capture: the orchestrator wrote
+``<workdir>/<exp>/<trial>/profile`` and nothing ever listed it.  This
+module makes captures discoverable three ways:
+
+- in-process: :func:`register_profile` records every capture; the UI
+  backend serves :func:`list_profiles` under ``/api/status``;
+- trace journal: the orchestrator wraps profiled attempts in a
+  ``profile.capture`` span carrying ``trace_dir``, so ``katib-tpu
+  profile --list`` (and ``trace summary``) see past runs from any
+  process;
+- filesystem: :func:`scan_profiles` globs ``<workdir>/*/*/profile`` as
+  the fallback for journals that predate the span.
+
+:func:`capture` is the one capture wrapper (the profiler is a
+process-global singleton — callers serialize; the orchestrator already
+holds ``_profile_lock`` around it).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import time
+from contextlib import contextmanager
+from typing import Iterator
+
+from katib_tpu.analysis import make_lock
+
+_PROFILES: list[dict] = []
+_PROFILES_MAX = 64
+_PROFILES_LOCK = make_lock("costmodel.profiles")
+
+PROFILE_SPAN = "profile.capture"
+
+
+def register_profile(
+    trace_dir: str, *, trial: str | None = None, experiment: str | None = None
+) -> dict:
+    """Record one capture in the in-process registry (served by
+    ``/api/status``); returns the entry."""
+    entry = {
+        "trace_dir": str(trace_dir),
+        "trial": trial,
+        "experiment": experiment,
+        "wall": round(time.time(), 3),
+    }
+    with _PROFILES_LOCK:
+        _PROFILES.append(entry)
+        del _PROFILES[:-_PROFILES_MAX]
+    return dict(entry)
+
+
+def list_profiles() -> list[dict]:
+    with _PROFILES_LOCK:
+        return [dict(e) for e in _PROFILES]
+
+
+def reset() -> None:
+    """Forget registered captures (tests)."""
+    with _PROFILES_LOCK:
+        _PROFILES.clear()
+
+
+@contextmanager
+def capture(
+    trace_dir: str, *, trial: str | None = None, experiment: str | None = None
+) -> Iterator[str]:
+    """``jax.profiler.trace`` into ``trace_dir``, registered on entry and
+    bracketed by a ``profile.capture`` span so the directory is linked
+    from both ``/api/status`` and the trace journal.  The jax profiler is
+    a process-global singleton — do not nest captures."""
+    import jax
+
+    from katib_tpu.utils import tracing
+
+    os.makedirs(trace_dir, exist_ok=True)
+    register_profile(trace_dir, trial=trial, experiment=experiment)
+    with tracing.span(PROFILE_SPAN, trial=trial, trace_dir=trace_dir):
+        with jax.profiler.trace(trace_dir):
+            yield trace_dir
+
+
+def scan_profiles(workdir: str) -> list[dict]:
+    """Offline discovery: profile directories under
+    ``<workdir>/<experiment>/<trial>/profile`` plus any ``trace_dir``
+    recorded on ``profile.capture`` spans in the experiments' journals."""
+    from katib_tpu.utils import tracing
+
+    found: dict[str, dict] = {}
+    for d in sorted(glob.glob(os.path.join(workdir, "*", "*", "profile"))):
+        if not os.path.isdir(d):
+            continue
+        rel = os.path.relpath(d, workdir).split(os.sep)
+        found[os.path.abspath(d)] = {
+            "trace_dir": d,
+            "experiment": rel[0] if len(rel) > 2 else None,
+            "trial": rel[1] if len(rel) > 2 else None,
+            "source": "filesystem",
+        }
+    for journal in sorted(glob.glob(os.path.join(workdir, "*", tracing.TRACE_FILE))):
+        exp = os.path.basename(os.path.dirname(journal))
+        for rec in tracing.read_journal(journal):
+            if rec.get("name") != PROFILE_SPAN:
+                continue
+            args = rec.get("args", {}) or {}
+            d = args.get("trace_dir")
+            if not d:
+                continue
+            entry = found.setdefault(
+                os.path.abspath(str(d)),
+                {"trace_dir": str(d), "experiment": exp, "source": "journal"},
+            )
+            if args.get("trial"):
+                entry["trial"] = args.get("trial")
+            if rec.get("wall") is not None:
+                entry["wall"] = rec.get("wall")
+    return sorted(found.values(), key=lambda e: str(e.get("trace_dir")))

@@ -1,0 +1,187 @@
+"""CostRecord — XLA-derived cost of one compiled program, plus extraction.
+
+A :class:`CostRecord` is what the shape registry persists next to each
+compile signature and what the live gauges divide by measured step time:
+flops and bytes accessed for ONE dispatch of the program, the peak-HBM
+footprint when a ``Compiled`` object was available, and ``steps`` — how
+many training steps that dispatch folds (a ``lax.scan`` epoch program
+carries the whole epoch's flops; per-step math divides by ``steps``).
+
+Extraction is tolerant by design: ``cost_analysis`` availability varies
+by backend and jax version, and a program we cannot cost must train
+exactly as if this module didn't exist — every helper returns ``None``
+on failure instead of raising.
+
+``bytes accessed`` is XLA's PRE-FUSION figure (every op's operands and
+results), which overstates real HBM traffic — the bandwidth floor it
+produces is a lower bound on step time and the derived ``max_mfu`` an
+upper bound on what the program can reach (same caveat ``bench.py``'s
+AOT block always documented).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Mapping
+
+from katib_tpu.costmodel.peaks import DevicePeaks, peaks_for
+
+
+def _first_dict(cost: Any) -> Mapping[str, Any]:
+    """``cost_analysis()`` returns a dict or a per-computation list of
+    dicts depending on jax version/backend — normalize to one mapping."""
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    return cost if isinstance(cost, Mapping) else {}
+
+
+@dataclass
+class CostRecord:
+    """Cost of one dispatch of one compiled program."""
+
+    program: str = "?"
+    flops: float = 0.0
+    bytes_accessed: float = 0.0
+    hbm_bytes: int = 0  # args+outputs+temps+code; 0 when unknown (no Compiled)
+    steps: int = 1  # training steps folded into one dispatch of this program
+    dtype: str = "bf16"
+
+    @property
+    def flops_per_step(self) -> float:
+        return self.flops / max(self.steps, 1)
+
+    @property
+    def bytes_per_step(self) -> float:
+        return self.bytes_accessed / max(self.steps, 1)
+
+    @property
+    def arithmetic_intensity(self) -> float:
+        """flops per byte accessed (0 when bytes are unknown)."""
+        return self.flops / self.bytes_accessed if self.bytes_accessed else 0.0
+
+    def roofline(self, peaks: DevicePeaks | None = None) -> dict:
+        """Roofline placement against ``peaks`` (detected when None).
+
+        Returns the per-step compute floor (time at MFU=1), the
+        pre-fusion bandwidth floor, the binding floor and its class
+        (``compute-bound`` / ``memory-bound``), and ``max_mfu`` — the
+        utilization ceiling the binding floor allows (1.0 when compute
+        bound, ``intensity/ridge`` when memory bound)."""
+        pk = peaks or peaks_for()
+        peak = pk.peak_flops(self.dtype)
+        compute_floor = self.flops_per_step / peak if peak else 0.0
+        bw_floor = (
+            self.bytes_per_step / pk.hbm_bandwidth if pk.hbm_bandwidth else 0.0
+        )
+        floor = max(compute_floor, bw_floor)
+        bound = "compute-bound" if compute_floor >= bw_floor else "memory-bound"
+        max_mfu = compute_floor / floor if floor else 0.0
+        return {
+            "device_kind": pk.device_kind,
+            "compute_floor_step_secs": compute_floor,
+            "prefusion_bw_step_secs": bw_floor,
+            "floor_step_secs": floor,
+            "bound": bound,
+            "arithmetic_intensity": self.arithmetic_intensity,
+            "ridge_intensity": pk.ridge_intensity,
+            "max_mfu": max_mfu,
+        }
+
+    def mfu(self, step_secs: float, peaks: DevicePeaks | None = None) -> float:
+        """Model-flops utilization at a measured per-step time."""
+        if step_secs <= 0 or not self.flops:
+            return 0.0
+        pk = peaks or peaks_for()
+        peak = pk.peak_flops(self.dtype)
+        return (self.flops_per_step / step_secs) / peak if peak else 0.0
+
+    def as_dict(self) -> dict:
+        return {
+            "program": self.program,
+            "flops": self.flops,
+            "bytes_accessed": self.bytes_accessed,
+            "hbm_bytes": self.hbm_bytes,
+            "steps": self.steps,
+            "dtype": self.dtype,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "CostRecord":
+        try:
+            return cls(
+                program=str(d.get("program", "?")),
+                flops=float(d.get("flops", 0.0)),
+                bytes_accessed=float(d.get("bytes_accessed", 0.0)),
+                hbm_bytes=int(d.get("hbm_bytes", 0)),
+                steps=max(1, int(d.get("steps", 1))),
+                dtype=str(d.get("dtype", "bf16")),
+            )
+        except (TypeError, ValueError):
+            return cls()
+
+
+def cost_of_lowered(
+    lowered: Any, *, program: str = "?", steps: int = 1, dtype: str = "bf16"
+) -> CostRecord | None:
+    """Cost from a ``jax.stages.Lowered`` — trace-time only, no XLA
+    compile behind it (HBM footprint stays 0: that needs a Compiled)."""
+    try:
+        cost = _first_dict(lowered.cost_analysis())
+        return CostRecord(
+            program=program,
+            flops=float(cost.get("flops", 0.0)),
+            bytes_accessed=float(cost.get("bytes accessed", 0.0)),
+            steps=max(1, int(steps)),
+            dtype=dtype,
+        )
+    except Exception:
+        return None
+
+
+def cost_of_compiled(
+    compiled: Any, *, program: str = "?", steps: int = 1, dtype: str = "bf16"
+) -> CostRecord | None:
+    """Cost from a ``jax.stages.Compiled`` — adds the peak-HBM footprint
+    (argument + output + temp + generated-code bytes) to the flop/byte
+    counts."""
+    try:
+        cost = _first_dict(compiled.cost_analysis())
+        rec = CostRecord(
+            program=program,
+            flops=float(cost.get("flops", 0.0)),
+            bytes_accessed=float(cost.get("bytes accessed", 0.0)),
+            steps=max(1, int(steps)),
+            dtype=dtype,
+        )
+    except Exception:
+        return None
+    try:
+        mem = compiled.memory_analysis()
+        rec.hbm_bytes = int(
+            mem.argument_size_in_bytes
+            + mem.output_size_in_bytes
+            + mem.temp_size_in_bytes
+            + mem.generated_code_size_in_bytes
+        )
+    except Exception:
+        pass  # memory analysis is optional; the flop counts stand alone
+    return rec
+
+
+def extract_cost(
+    fn: Any,
+    args: tuple = (),
+    *,
+    program: str = "?",
+    steps: int = 1,
+    dtype: str = "bf16",
+) -> CostRecord | None:
+    """Cost of a jitted function at ``args`` avals — one extra trace via
+    ``fn.lower(*args)``, no compile (``args`` may be concrete arrays or
+    ``jax.ShapeDtypeStruct``s; donated operands are fine, lowering reads
+    shapes only)."""
+    try:
+        lowered = fn.lower(*args)
+    except Exception:
+        return None
+    return cost_of_lowered(lowered, program=program, steps=steps, dtype=dtype)

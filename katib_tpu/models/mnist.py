@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from katib_tpu import costmodel
 from katib_tpu.models.data import Dataset, batches, load_mnist
 from katib_tpu.parallel.mesh import shard_batch
 from katib_tpu.parallel.train import (
@@ -156,6 +157,12 @@ def _build_steps(model: nn.Module, optimizer: str, mesh, augment_fn=None):
         jax.jit(lambda k, xb: augment_fn(k, xb)) if augment_fn is not None else None
     )
     return tx, step, evaluate, scan_epoch, aug_step
+
+
+def _model_dtype(model) -> str:
+    """Compute-dtype key for the MFU denominator (flax modules here cast
+    to their ``dtype`` field internally; f32 inputs still run bf16 math)."""
+    return "bf16" if getattr(model, "dtype", None) == jnp.bfloat16 else "f32"
 
 
 def _mesh_key(mesh):
@@ -303,15 +310,26 @@ def train_classifier(
             # same rng draw as batches() below: one permutation per epoch
             # from the same sequential generator
             idx = rng.permutation(len(dataset.x_train))[: scan_steps * batch_size]
-            state, losses = scan_epoch(
-                state,
-                xd,
-                yd,
-                jnp.asarray(idx.reshape(scan_steps, batch_size), jnp.int32),
-                aug_key,
-            )
+            idx_d = jnp.asarray(idx.reshape(scan_steps, batch_size), jnp.int32)
+            state, losses = scan_epoch(state, xd, yd, idx_d, aug_key)
             n = scan_steps
             train_loss = float(jnp.sum(losses))
+            if epoch == 0:
+                # one report covers ONE dispatch of this epoch program
+                # (steps = the folded scan length); observed after the
+                # first dispatch so warm/cold classification timing stays
+                # untouched.  Memoized on the step-cache key: concurrent
+                # sweep trials sharing the executable trace it once.
+                costmodel.observe_program(
+                    ("mnist.scan", model, optimizer, _mesh_key(mesh),
+                     augment_fn, batch_size, scan_steps),
+                    scan_epoch,
+                    (state, xd, yd, idx_d, aug_key),
+                    program="train_classifier.scan_epoch",
+                    steps=scan_steps,
+                    per_report=1,
+                    dtype=_model_dtype(model),
+                )
         else:
             # device futures, one transfer per epoch — per-step float()
             # would host-sync every step and serialize async dispatch (see
@@ -335,6 +353,18 @@ def train_classifier(
                 step_losses.append(metrics["loss"])
             n = len(step_losses)
             train_loss = float(np.sum(jax.device_get(step_losses))) if n else 0.0
+            if epoch == 0 and n:
+                # streamed path: one report covers n single-step dispatches
+                costmodel.observe_program(
+                    ("mnist.step", model, optimizer, _mesh_key(mesh),
+                     augment_fn, batch_size),
+                    step,
+                    (state, batch),
+                    program="train_classifier.step",
+                    steps=1,
+                    per_report=n,
+                    dtype=_model_dtype(model),
+                )
         em = evaluate(state.params, ebatch)
         test_acc = float(em["accuracy"])
         if report is not None:
@@ -472,6 +502,19 @@ def mnist_cohort_trial(cctx) -> None:
             # shared batch, mapped states: in_axes=(0, None) inside the step
             state, metrics = step(state, (xd[b], yd[b]))
             losses.append(metrics["loss"])  # [K], device future
+        if epoch == 0 and scan_steps >= 1:
+            # whole-cohort program cost ([K]-batched step); one report
+            # covers scan_steps dispatches of it
+            costmodel.observe_program(
+                ("mnist.cohort", model, optimizer,
+                 _mesh_key(cctx.cohort_mesh), k, batch_size),
+                step,
+                (state, (xd[b], yd[b])),
+                program="mnist_cohort_trial.step",
+                steps=1,
+                per_report=scan_steps,
+                dtype=_model_dtype(model),
+            )
         train_loss = (
             jnp.sum(jnp.stack(losses), axis=0) if losses else jnp.zeros((k,))
         )
@@ -572,6 +615,18 @@ def mnist_prewarm(shared: dict, k: int, mesh=None) -> None:
             batch = (xb, yb)
             ebatch = (xe, ye)
         state, _ = step(state, batch)
+        # same memo label as mnist_cohort_trial: the prewarm twin and the
+        # real cohort share one executable, so they share one cost record
+        # (the ambient slot feeds PrewarmWorker's registry cost merge)
+        costmodel.observe_program(
+            ("mnist.cohort", model, optimizer, _mesh_key(cmesh), k, batch_size),
+            step,
+            (state, batch),
+            program="mnist_cohort_trial.step",
+            steps=1,
+            per_report=max(1, n_train // batch_size),
+            dtype=_model_dtype(model),
+        )
         em = evaluate(state.params, ebatch)
     else:
         import os
@@ -591,16 +646,34 @@ def mnist_prewarm(shared: dict, k: int, mesh=None) -> None:
         device_data = mesh is None if env is None else parse_bool(env)
         scan_steps = n_train // batch_size
         if device_data and mesh is None and scan_steps >= 1:
-            state, _ = scan_epoch(
-                state,
-                jnp.zeros((n_train, *shape), jnp.float32),
-                jnp.zeros((n_train,), jnp.int32),
-                jnp.zeros((scan_steps, batch_size), jnp.int32),
-                jax.random.PRNGKey(0),
+            xz = jnp.zeros((n_train, *shape), jnp.float32)
+            yz = jnp.zeros((n_train,), jnp.int32)
+            iz = jnp.zeros((scan_steps, batch_size), jnp.int32)
+            kz = jax.random.PRNGKey(0)
+            state, _ = scan_epoch(state, xz, yz, iz, kz)
+            costmodel.observe_program(
+                ("mnist.scan", model, optimizer, _mesh_key(mesh),
+                 None, batch_size, scan_steps),
+                scan_epoch,
+                (state, xz, yz, iz, kz),
+                program="train_classifier.scan_epoch",
+                steps=scan_steps,
+                per_report=1,
+                dtype=_model_dtype(model),
             )
         else:
             batch = (xb, yb) if mesh is None else shard_batch((xb, yb), mesh)
             state, _ = step(state, batch)
+            costmodel.observe_program(
+                ("mnist.step", model, optimizer, _mesh_key(mesh),
+                 None, batch_size),
+                step,
+                (state, batch),
+                program="train_classifier.step",
+                steps=1,
+                per_report=max(1, scan_steps),
+                dtype=_model_dtype(model),
+            )
         # eval prefix: same truncate/tile placement as train_classifier
         xe = np.zeros((ne, *shape), np.float32)
         ye = np.zeros((ne,), np.int32)

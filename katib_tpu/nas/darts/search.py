@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from katib_tpu import costmodel
 from katib_tpu.models.data import Dataset, batches, load_named_dataset
 from katib_tpu.nas.darts.architect import (
     DartsHyper,
@@ -78,6 +79,7 @@ def _record_first_step(compile_s: float, execute_s: float, workload: str) -> Non
     per-workload signature — classify + record only, NO hit/miss counters:
     orchestrator-driven darts trials already count once at the runner's
     first-step seam, and a double bump would overstate the hit rate."""
+    from katib_tpu import costmodel
     from katib_tpu.compile.registry import REGISTRY, CompileSignature
 
     cache = "unknown"
@@ -85,6 +87,12 @@ def _record_first_step(compile_s: float, execute_s: float, workload: str) -> Non
         sig = CompileSignature(program=f"darts:{workload}")
         cache = REGISTRY.classify(sig)
         REGISTRY.record(sig, source="darts", compile_seconds=compile_s)
+        # the search observes its step/window program into the ambient
+        # slot right before calling here — persist the XLA cost next to
+        # the darts signature
+        active = costmodel.active_cost()
+        if active is not None:
+            REGISTRY.record_cost(sig, active[0].as_dict())
     except Exception:
         pass  # classification is telemetry, never a search failure
     obs.trial_first_step_seconds.set(
@@ -532,6 +540,10 @@ def run_darts_search(
     # time base continues across restarts so elapsed_s stays monotonic
     t0 = time.perf_counter() - resumed_elapsed
     trace_epochs = parse_bool(os.environ.get("KATIB_EPOCH_TRACE"))
+    # roofline: the XLA cost of this search's compiled step/window program,
+    # observed once on the start epoch and re-published against each
+    # epoch's measured step time (darts.epoch span attrs + MFU gauges)
+    cost_rec = None
 
     def _trace(tag: str, since: float) -> float:
         now = time.perf_counter()
@@ -554,18 +566,24 @@ def run_darts_search(
                 loss_parts = []
                 dispatches = 0
                 pos = 0
+                first_avals = None
+                first_window = 0
                 while pos < scan_steps:
                     k = min(window, scan_steps - pos)
+                    w_j = jnp.asarray(w_ix[pos : pos + k], jnp.int32)
+                    a_j = jnp.asarray(a_ix[pos : pos + k], jnp.int32)
+                    if epoch == start_epoch and pos == 0:
+                        # shape-only avals (window_fn donates the state, so
+                        # the live operands can't be reused after the call)
+                        first_avals = jax.tree.map(
+                            lambda t: jax.ShapeDtypeStruct(t.shape, t.dtype),
+                            (state, xw_d, yw_d, xa_d, ya_d, w_j, a_j),
+                        )
+                        first_window = k
                     # full windows all reuse one executable; the remainder
                     # chunk (at most one per epoch) gets its own trace
                     state, losses = window_fn(
-                        state,
-                        xw_d,
-                        yw_d,
-                        xa_d,
-                        ya_d,
-                        jnp.asarray(w_ix[pos : pos + k], jnp.int32),
-                        jnp.asarray(a_ix[pos : pos + k], jnp.int32),
+                        state, xw_d, yw_d, xa_d, ya_d, w_j, a_j
                     )
                     loss_parts.append(losses)
                     dispatches += 1
@@ -581,6 +599,17 @@ def run_darts_search(
                 fetch_s = time.perf_counter() - t_fetch
                 t_mark = _trace("loss-fetch", t_mark)
                 if epoch == start_epoch:
+                    if first_avals is not None:
+                        # per-run program (fresh jit per search): no memo
+                        # label, trace-only extraction off the timed path
+                        cost_rec = costmodel.observe_program(
+                            None,
+                            window_fn,
+                            first_avals,
+                            program="darts:darts-scan",
+                            steps=first_window,
+                            per_report=dispatches,
+                        )
                     # windowed scan: the first dispatch blocks on
                     # trace+compile, the loss fetch blocks on execution
                     _record_first_step(dispatch_s, fetch_s, "darts-scan")
@@ -646,11 +675,23 @@ def run_darts_search(
                         )
                     if first_pending:
                         first_pending = False
+                        first_avals = jax.tree.map(
+                            lambda t: jax.ShapeDtypeStruct(t.shape, t.dtype),
+                            (state, wb, ab),
+                        )
                         t_first = time.perf_counter()
                         state, metrics = search_step(state, wb, ab)
                         compile_s = time.perf_counter() - t_first
                         t_first = time.perf_counter()
                         jax.block_until_ready(metrics["train_loss"])
+                        cost_rec = costmodel.observe_program(
+                            None,
+                            search_step,
+                            first_avals,
+                            program="darts:darts",
+                            steps=1,
+                            per_report=max(1, scan_steps),
+                        )
                         _record_first_step(
                             compile_s, time.perf_counter() - t_first, "darts"
                         )
@@ -681,6 +722,15 @@ def run_darts_search(
             # means the scan loop is folding that many steps per dispatch
             spd = steps / dispatches if dispatches else 0.0
             obs.steps_per_dispatch.set(spd, workload="darts")
+            # roofline gauges against this epoch's measured per-step time
+            # (includes eval, so MFU reads slightly conservative)
+            cost_attrs = (
+                costmodel.publish_dispatch(
+                    cost_rec, epoch_s / max(steps, 1), workload="darts"
+                )
+                if cost_rec is not None
+                else {}
+            )
             tracing.record_span(
                 "darts.epoch",
                 epoch_s,
@@ -692,6 +742,7 @@ def run_darts_search(
                 step_loop_window=window if window_fn is not None else 0,
                 device_data=bool(window_fn is not None or gather_batches is not None),
                 steps_per_dispatch=round(spd, 2),
+                **cost_attrs,
             )
             history.append(
                 {

@@ -939,6 +939,16 @@ class Orchestrator:
             with tracing.span("trial", trial=trial.name) as sp:
                 result = self._execute_inner(exp, trial, mesh)
                 sp.set(condition=result.condition.value)
+                try:
+                    # roofline attrs the runner's heartbeats published on
+                    # this thread (empty when the trial observed no cost)
+                    from katib_tpu import costmodel
+
+                    attrs = costmodel.span_attrs()
+                    if attrs:
+                        sp.set(**attrs)
+                except Exception:
+                    pass
                 return result
 
     def _execute_inner(self, exp: Experiment, trial: Trial, mesh):
@@ -1047,10 +1057,16 @@ class Orchestrator:
         want_profile = self.config is not None and self.config.init.enable_profiler
         if want_profile and self._profile_lock.acquire(blocking=False):
             try:
-                import jax
+                from katib_tpu.costmodel import profiler as costprofiler
 
                 trace_dir = os.path.join(trial.checkpoint_dir, "profile")
-                with jax.profiler.trace(trace_dir):
+                # capture() registers the dir (served by /api/status and
+                # `katib-tpu profile --list`) and brackets the attempt in a
+                # profile.capture span carrying trace_dir, so the capture
+                # stays discoverable after the run
+                with costprofiler.capture(
+                    trace_dir, trial=trial.name, experiment=exp.name
+                ):
                     return run_trial(
                         trial, self.store, exp.spec.objective,
                         mesh=mesh, stop_event=self._stop_event,

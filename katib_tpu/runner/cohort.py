@@ -421,23 +421,53 @@ def run_cohort(
     # report closes the trace+compile+first-dispatch window; the shape
     # registry (katib_tpu/compile) decides whether that compile should have
     # hit the cache and feeds the hit/miss counters
+    from katib_tpu import costmodel
     from katib_tpu.compile import registry as compile_registry
 
     sig_holder: list = [None]
     first_step_at: list[float] = [0.0]
+    # classification drains sig_holder on the first beat; roofline
+    # publication and cost persistence keep using the tier's signature
+    cost_sig_holder: list = [None]
+    last_beat: list[float] = [0.0]
+    cost_attrs: dict = {}
 
     def _beat() -> None:
+        now = time.perf_counter()
         sig = sig_holder[0]
         if sig is not None:
             sig_holder[0] = None
             try:
-                dt = time.perf_counter() - first_step_at[0]
+                dt = now - first_step_at[0]
                 label = compile_registry.REGISTRY.note_first_step(sig, dt)
                 obs.trial_first_step_seconds.set(
                     dt, phase="first_report", cache=label, workload=sig.program
                 )
             except Exception:
                 pass  # classification is telemetry, never a cohort failure
+        else:
+            # steady-state interval (the first one folds compile — skip):
+            # the cohort program's observed cost over the report cadence
+            active = costmodel.active_cost()
+            csig = cost_sig_holder[0]
+            if active is not None and csig is not None:
+                rec, per_report = active
+                interval = now - last_beat[0]
+                steps = max(1, rec.steps * per_report)
+                attrs = costmodel.publish_dispatch(
+                    rec, interval / steps, workload=csig.program
+                )
+                if attrs:
+                    cost_attrs.update(attrs)
+        active = costmodel.active_cost()
+        if active is not None and cost_sig_holder[0] is not None:
+            try:
+                compile_registry.REGISTRY.record_cost(
+                    cost_sig_holder[0], active[0].as_dict()
+                )
+            except Exception:
+                pass
+        last_beat[0] = now
         hb = compile_hb_holder[0]
         if hb is not None:
             # first step-boundary report = first dispatch done
@@ -484,7 +514,10 @@ def run_cohort(
                 sig_holder[0] = compile_registry.cohort_signature(
                     cohort_fn, survivors, ctx.padded_size, ctx.cohort_mesh
                 )
+                cost_sig_holder[0] = sig_holder[0]
+                costmodel.clear_active()  # fresh tier = fresh program cost
                 first_step_at[0] = time.perf_counter()
+                last_beat[0] = first_step_at[0]
                 with tracing.span(
                     "cohort",
                     size=k,
@@ -492,8 +525,10 @@ def run_cohort(
                     devices=devices,
                     members_per_device=ctx.padded_size // devices,
                     tier=tier,
-                ):
+                ) as cohort_sp:
                     cohort_fn(ctx)
+                    if cost_attrs:
+                        cohort_sp.set(**cost_attrs)
                 break
             except Exception as e:
                 kind = classify_exception(e)
@@ -568,5 +603,6 @@ def run_cohort(
             condition=results[t.name].condition.value,
             cohort=key,
             cohort_size=k,
+            **cost_attrs,
         )
     return results

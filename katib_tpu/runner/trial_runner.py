@@ -239,6 +239,7 @@ def _run_whitebox(
     # first step boundary (trace + compile + first dispatch behind it); the
     # shape registry decides whether that compile should have been a cache
     # hit and feeds the hit/miss counters + warm-vs-cold histogram
+    from katib_tpu import costmodel
     from katib_tpu.compile import registry as compile_registry
 
     first_step_sig = compile_registry.trial_signature(
@@ -246,12 +247,15 @@ def _run_whitebox(
     )
     started_holder = [time.perf_counter()]
     first_step_seen = [False]
+    last_beat = [0.0]
+    cost_attrs: dict = {}
 
     def _beat() -> None:
+        now = time.perf_counter()
         if not first_step_seen[0]:
             first_step_seen[0] = True
             try:
-                dt = time.perf_counter() - started_holder[0]
+                dt = now - started_holder[0]
                 label = compile_registry.REGISTRY.note_first_step(
                     first_step_sig, dt
                 )
@@ -263,6 +267,31 @@ def _run_whitebox(
                 )
             except Exception:
                 pass  # classification is telemetry, never a trial failure
+        else:
+            # steady-state report interval (first interval folds compile —
+            # skip it): combine the model's observed program cost with the
+            # measured cadence into the live roofline gauges
+            active = costmodel.active_cost()
+            if active is not None:
+                rec, per_report = active
+                interval = now - last_beat[0]
+                steps = max(1, rec.steps * per_report)
+                attrs = costmodel.publish_dispatch(
+                    rec, interval / steps, workload=first_step_sig.program
+                )
+                if attrs:
+                    cost_attrs.update(attrs)
+        # persist the program's XLA cost next to its compile signature
+        # (idempotent; the model may observe only after its first epoch)
+        active = costmodel.active_cost()
+        if active is not None:
+            try:
+                compile_registry.REGISTRY.record_cost(
+                    first_step_sig, active[0].as_dict()
+                )
+            except Exception:
+                pass
+        last_beat[0] = now
         if compile_hb is not None:
             # first metric report = first dispatch completed: compile is done
             compile_hb.close()
@@ -323,9 +352,15 @@ def _run_whitebox(
             # did decides the settlement (HANG / KILLED / DRAINED)
             injector.maybe_hang(trial, events=(hang_event, stop_event, drain_event))
             ctx.raise_if_stopped()
+        # executor threads are reused: a previous trial's observed cost
+        # must not leak into this trial's heartbeat publications
+        costmodel.clear_active()
         started_holder[0] = time.perf_counter()  # first-step clock starts here
-        with tracing.span("train_fn", trial=trial.name):
+        last_beat[0] = started_holder[0]
+        with tracing.span("train_fn", trial=trial.name) as sp:
             trial.spec.train_fn(ctx)
+            if cost_attrs:
+                sp.set(**cost_attrs)
     except TrialEarlyStopped as e:
         if evaluator.triggered is not None:
             return TrialResult(TrialCondition.EARLY_STOPPED, str(e))

@@ -295,6 +295,7 @@ class UiServer:
         """Live in-process metrics snapshot (counters, gauges, histogram
         aggregates) — the dashboard's counter strip reads this instead of
         scraping the Prometheus endpoint separately."""
+        from katib_tpu.costmodel.profiler import list_profiles
         from katib_tpu.utils.observability import REGISTRY
         from katib_tpu.utils.meshhealth import last_report_dict
 
@@ -304,6 +305,9 @@ class UiServer:
             # last device-preflight verdict of this process (None until a
             # doctor/preflight probe ran) — per-device health rows
             "device_health": last_report_dict(),
+            # profiler captures taken by this process (enable_profiler
+            # trials, ad-hoc `katib-tpu profile` runs): trace_dir + trial
+            "profiles": list_profiles(),
         }
 
     def experiment(self, name: str):

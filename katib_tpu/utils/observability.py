@@ -426,6 +426,27 @@ steps_per_dispatch = REGISTRY.gauge(
     "stepping — the first thing to check when MFU is low)",
 )
 
+# -- roofline telemetry (katib_tpu/costmodel/) --------------------------------
+
+dispatch_mfu = REGISTRY.gauge(
+    "katib_dispatch_mfu",
+    "Model-flops utilization of the live dispatch path: XLA-counted flops "
+    "per measured step second over the device kind's peak "
+    "(costmodel.peaks; KATIB_PEAK_FLOPS overrides the denominator)",
+)
+arithmetic_intensity = REGISTRY.gauge(
+    "katib_arithmetic_intensity",
+    "Flops per byte accessed of the live program (XLA pre-fusion bytes); "
+    "below the device's ridge intensity the program is memory-bound and "
+    "no dispatch tuning reaches peak flops",
+)
+roofline_headroom = REGISTRY.gauge(
+    "katib_roofline_headroom",
+    "Measured step time over the program's binding roofline floor "
+    "(1.0 = running at the roofline; 10 = 10x slack — look at "
+    "katib_steps_per_dispatch and the trace journal before the kernel)",
+)
+
 # -- async orchestration (orchestrator/async_loops.py) ------------------------
 
 suggest_seconds = REGISTRY.histogram(
