@@ -15,9 +15,24 @@ pieces keep cohort dispatches on a warm cache:
 - :mod:`katib_tpu.compile.prewarm` runs a strictly best-effort background
   worker that compiles upcoming cohort programs (fed by the orchestrator's
   proposal groups) while current trials execute, so the next cohort's
-  first step deserializes instead of recompiling.
+  first step deserializes instead of recompiling;
+- :mod:`katib_tpu.compile.artifacts` makes compiled executables portable
+  *across hosts*: serialized AOT executables in a content-addressed,
+  tiered artifact cache (local dir → shared dir → cold compile) keyed by
+  compile signature + environment fingerprint, so a brand-new host's
+  first step fetches instead of compiling.
 """
 
+from katib_tpu.compile.artifacts import (  # noqa: F401
+    ARTIFACTS,
+    ArtifactCache,
+    DirectoryBackend,
+    LoadedArtifact,
+    env_fingerprint,
+    fsck_artifacts,
+    is_artifact_dir,
+    resolve,
+)
 from katib_tpu.compile.buckets import (  # noqa: F401
     bucket_size,
     bucket_table,

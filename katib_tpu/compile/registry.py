@@ -201,6 +201,7 @@ class ShapeRegistry:
                 offset = 0
                 valid_end = 0
                 torn = 0
+                dupes = 0
                 for raw in f:
                     offset += len(raw)
                     line = raw.decode("utf-8", errors="replace").strip()
@@ -219,11 +220,14 @@ class ShapeRegistry:
                     key = rec.get("key") if isinstance(rec, dict) else None
                     if key:
                         cur = self._seen.setdefault(key, rec)
-                        if cur is not rec and isinstance(rec.get("cost"), dict):
-                            # first record wins for identity fields, but a
-                            # later cost-bearing line (record_cost re-appends
-                            # the row) carries the freshest XLA analysis
-                            cur["cost"] = rec["cost"]
+                        if cur is not rec:
+                            dupes += 1
+                            if isinstance(rec.get("cost"), dict):
+                                # first record wins for identity fields,
+                                # but a later cost-bearing line
+                                # (record_cost re-appends the row) carries
+                                # the freshest XLA analysis
+                                cur["cost"] = rec["cost"]
                 if torn:
                     import warnings
 
@@ -235,8 +239,30 @@ class ShapeRegistry:
                         stacklevel=2,
                     )
                     self._truncate_to = valid_end
+                if dupes:
+                    # record_cost re-appends its row on every cost change,
+                    # so a long-lived cache dir accretes duplicate lines
+                    # without bound: compact to one merged row per key.
+                    # The durable rewrite (tmp + fsync + rename, same
+                    # recipe as the journal) also heals any torn tail.
+                    self._compact(path)
         except OSError:
             pass
+
+    def _compact(self, path: str) -> None:  # lint: holds(_lock)
+        """Durably rewrite the registry file as one merged row per
+        signature (the in-memory view).  A concurrent reader sees the old
+        file or the compacted one, never a partial rewrite."""
+        try:
+            from katib_tpu.utils.fsio import atomic_replace
+
+            body = "".join(
+                json.dumps(rec) + "\n" for rec in self._seen.values()
+            )
+            atomic_replace(path, body.encode("utf-8"), prefix=".compact-")
+            self._truncate_to = None
+        except OSError:
+            pass  # compaction is housekeeping, never a failure
 
     def _append(self, rec: dict) -> None:  # lint: holds(_lock)
         path = self._path()

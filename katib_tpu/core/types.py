@@ -693,6 +693,13 @@ class ExperimentSpec:
     # (jax_compilation_cache_dir); None falls back to the
     # KATIB_COMPILE_CACHE env var, empty/unset disables.
     compile_cache: str | None = None
+    # Shared artifact tier: a fleet-shared directory of serialized AOT
+    # executables (compile/artifacts.py).  With it wired, the prewarm
+    # worker publishes what it compiles and the dispatch path fetches
+    # before tracing, so a brand-new host's first step is warm.  None
+    # falls back to KATIB_ARTIFACT_DIR; empty/unset disables the tier
+    # (the local <compile_cache>/artifacts tier still works).
+    artifact_dir: str | None = None
     # Hang watchdog: classify a trial FailureKind.HANG (and interrupt it)
     # when no progress signal lands for this long — propagated into every
     # TrialSpec (see TrialSpec.progress_deadline_seconds).  None = disabled.

@@ -1,0 +1,539 @@
+"""Serialized-executable artifact cache (``katib_tpu/compile/artifacts.py``).
+
+Covers the acceptance properties of the zero-cold-start layer:
+- envelope integrity: pack/unpack round-trips, and every corruption mode
+  (bad magic, torn body, flipped checksum) raises ``ArtifactCorrupt``
+  instead of misloading;
+- publish -> fetch round-trips a real jitted program with bit-identical
+  outputs on CPU;
+- invalidation: a changed environment fingerprint changes the content
+  address, so another env's artifact is a miss (stale, never misloaded);
+- degradation: corrupt/misaddressed envelopes quarantine and the fetch
+  returns empty — a trial always falls back to the cold compile;
+- atomicity: concurrent publishers of one signature leave exactly one
+  intact envelope and no temp files (tmp + rename);
+- the prewarm worker publishes each observed program once and satisfies
+  duplicate requests from the tier instead of recompiling;
+- the shape registry compacts duplicate JSONL rows on open while keeping
+  the journal's torn-tail tolerance;
+- per-tier hit/miss/publish/quarantine counters feed ``/api/status``.
+
+CPU-only: conftest forces 8 virtual CPU devices; ``serialize_executable``
+round-trips fine on the host platform.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import katib_tpu.compile.artifacts as artifacts
+from katib_tpu.compile.artifacts import (
+    ArtifactCache,
+    ArtifactCorrupt,
+    DirectoryBackend,
+    artifact_name,
+    env_fingerprint,
+    fingerprint_key,
+    fsck_artifacts,
+    is_artifact_dir,
+    pack_envelope,
+    publish_observed,
+    read_header,
+    resolve,
+    scan_dir,
+    serialize_compiled,
+    sig_from_key,
+    unpack_envelope,
+)
+from katib_tpu.compile.prewarm import (
+    PrewarmRequest,
+    PrewarmWorker,
+    attach_prewarm_fn,
+)
+from katib_tpu.compile.registry import (
+    REGISTRY,
+    CompileSignature,
+    ShapeRegistry,
+)
+from katib_tpu.utils import observability as obs
+
+
+def _tier_total(metric, tier: str) -> float:
+    return sum(
+        v for labels, v in metric.samples() if (labels or {}).get("tier") == tier
+    )
+
+
+def _sig(program: str = "artifact_test.step", k: int = 2) -> CompileSignature:
+    return CompileSignature(program=program, shapes=(("units", "8"),), k=k)
+
+
+def _jit_step():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(x, y):
+        return x @ y + jnp.tanh(x).sum()
+
+    return step
+
+
+def _args():
+    rng = np.random.default_rng(7)
+    return (
+        np.asarray(rng.normal(size=(4, 8)), dtype=np.float32),
+        np.asarray(rng.normal(size=(8, 8)), dtype=np.float32),
+    )
+
+
+@pytest.fixture
+def tiers(tmp_path, monkeypatch):
+    """A fresh two-tier world: local under ``tmp_path/xla/artifacts``,
+    shared at ``tmp_path/shared`` — the module singleton reset around it."""
+    monkeypatch.delenv("KATIB_ARTIFACT_DIR", raising=False)
+    monkeypatch.setattr(artifacts, "_cache_dir", lambda: str(tmp_path / "xla"))
+    artifacts.ARTIFACTS.reset()
+    artifacts.clear_observed()
+    cache = ArtifactCache()
+    cache.configure(str(tmp_path / "shared"))
+    yield cache, tmp_path
+    artifacts.ARTIFACTS.reset()
+    artifacts.clear_observed()
+
+
+class TestEnvelope:
+    def test_pack_unpack_roundtrip(self):
+        sig = _sig()
+        fp = env_fingerprint()
+        data = pack_envelope(
+            sig, fp, b"payload-bytes", None, None,
+            avals=[[[4, 8], "float32"]], cost={"flops": 12.0}, parent="p-key",
+        )
+        header, body = unpack_envelope(data)
+        assert header["key"] == sig.key()
+        assert header["program"] == sig.program
+        assert header["fingerprint"] == fp
+        assert header["cost"] == {"flops": 12.0}
+        assert header["parent"] == "p-key"
+        assert body["payload"] == b"payload-bytes"
+        # header-only parse sees the same identity without the unpickle
+        assert read_header(data)["key"] == sig.key()
+
+    @pytest.mark.parametrize(
+        "mutate",
+        [
+            lambda d: b"NOTMAGIC" + d[8:],  # bad magic
+            lambda d: d[:-3],  # torn body
+            lambda d: d[:-3] + b"xyz",  # flipped content, same length
+            lambda d: artifacts.MAGIC + b"not json\n" + d[-4:],  # bad header
+        ],
+    )
+    def test_corruption_raises(self, mutate):
+        data = pack_envelope(_sig(), env_fingerprint(), b"payload", None, None)
+        with pytest.raises(ArtifactCorrupt):
+            unpack_envelope(mutate(data))
+        with pytest.raises(ArtifactCorrupt):
+            read_header(mutate(data))
+
+    def test_sig_key_roundtrip(self):
+        sig = _sig(k=4)
+        assert sig_from_key(sig.key()).key() == sig.key()
+
+    def test_name_changes_with_fingerprint_and_sig(self):
+        fp = env_fingerprint()
+        other_env = dict(fp, jax="999.0.0")
+        name = artifact_name(_sig().key(), fp)
+        assert name.endswith(artifacts.SUFFIX)
+        assert artifact_name(_sig().key(), other_env) != name
+        assert artifact_name(_sig(k=4).key(), fp) != name
+
+
+class TestPublishFetch:
+    def test_round_trip_bit_identical(self, tiers):
+        cache, tmp = tiers
+        step, args = _jit_step(), _args()
+        want = np.asarray(step(*args))
+        compiled = serialize_compiled(step, args)
+        sig = _sig()
+        written = cache.publish(sig, compiled, cost={"flops": 5.0})
+        assert sorted(written) == ["local", "shared"]
+        # a different process: fresh cache object, same tiers on disk
+        other = ArtifactCache()
+        other.configure(str(tmp / "shared"))
+        la = other.fetch(sig)
+        assert la is not None and la.tier == "local"
+        assert la.cost == {"flops": 5.0}
+        got = np.asarray(la(*args))
+        np.testing.assert_array_equal(got, want)  # bit-identical, not close
+
+    def test_publish_dedupes_on_content_address(self, tiers):
+        cache, _ = tiers
+        compiled = serialize_compiled(_jit_step(), _args())
+        assert cache.publish(_sig(), compiled)
+        p0 = _tier_total(obs.artifact_publishes, "shared")
+        assert cache.publish(_sig(), compiled) == []  # both tiers exist
+        assert _tier_total(obs.artifact_publishes, "shared") == p0
+
+    def test_fingerprint_invalidation(self, tiers, monkeypatch):
+        cache, tmp = tiers
+        compiled = serialize_compiled(_jit_step(), _args())
+        sig = _sig()
+        cache.publish(sig, compiled)
+        # same dirs, different toolchain: the address changes, so the old
+        # artifact is simply never looked up
+        monkeypatch.setattr(
+            artifacts, "_FP_CACHE", dict(env_fingerprint(), jax="999.0.0")
+        )
+        upgraded = ArtifactCache()
+        upgraded.configure(str(tmp / "shared"))
+        m0 = _tier_total(obs.artifact_misses, "shared")
+        assert upgraded.fetch(sig) is None
+        assert upgraded.fetch_family(sig) == []
+        assert _tier_total(obs.artifact_misses, "shared") > m0
+        # the other env's envelope is stale, not corrupt: fsck leaves it
+        report = fsck_artifacts(str(tmp / "shared"))
+        assert report.stale and not report.corrupt and report.consistent
+
+    def test_corrupt_artifact_quarantined_and_fetch_degrades(self, tiers):
+        cache, tmp = tiers
+        compiled = serialize_compiled(_jit_step(), _args())
+        sig = _sig()
+        cache.publish(sig, compiled)
+        shared = tmp / "shared"
+        for d in (tmp / "xla" / "artifacts", shared):
+            for name in os.listdir(d):
+                p = d / name
+                p.write_bytes(p.read_bytes()[:-16])  # tear both copies
+        q0 = _tier_total(obs.artifact_quarantines, "shared")
+        other = ArtifactCache()
+        other.configure(str(shared))
+        assert other.fetch(sig) is None  # degraded, no raise
+        assert _tier_total(obs.artifact_quarantines, "shared") == q0 + 1
+        names = os.listdir(shared)
+        assert all(n.endswith(artifacts.QUARANTINE_SUFFIX) for n in names)
+        # a later fetch of the emptied tier is a plain miss
+        assert other.fetch(sig) is None
+
+    def test_shared_hit_promotes_to_local_tier(self, tiers, monkeypatch):
+        cache, tmp = tiers
+        compiled = serialize_compiled(_jit_step(), _args())
+        sig = _sig()
+        # publish from a host with no local tier: shared-only
+        monkeypatch.setattr(artifacts, "_cache_dir", lambda: None)
+        assert cache.publish(sig, compiled) == ["shared"]
+        # the fetching host has a local tier again
+        monkeypatch.setattr(
+            artifacts, "_cache_dir", lambda: str(tmp / "xla")
+        )
+        h0 = _tier_total(obs.artifact_hits, "shared")
+        other = ArtifactCache()
+        other.configure(str(tmp / "shared"))
+        la = other.fetch(sig)
+        assert la is not None and la.tier == "shared"
+        assert _tier_total(obs.artifact_hits, "shared") == h0 + 1
+        promoted = os.listdir(tmp / "xla" / "artifacts")
+        assert promoted == [artifact_name(sig.key(), env_fingerprint())]
+
+    def test_concurrent_publish_atomic(self, tiers):
+        cache, tmp = tiers
+        compiled = serialize_compiled(_jit_step(), _args())
+        sig, n = _sig(), 8
+        barrier = threading.Barrier(n)
+        errors: list[BaseException] = []
+
+        def racer():
+            try:
+                barrier.wait(10.0)
+                ArtifactCache().publish(sig, compiled)
+            except BaseException as e:  # pragma: no cover - fail loudly
+                errors.append(e)
+
+        threads = [threading.Thread(target=racer) for _ in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30.0)
+        assert not errors
+        names = os.listdir(tmp / "xla" / "artifacts")
+        # exactly one envelope, intact, and no .pub- temp residue
+        assert names == [artifact_name(sig.key(), env_fingerprint())]
+        data = (tmp / "xla" / "artifacts" / names[0]).read_bytes()
+        assert unpack_envelope(data)[0]["key"] == sig.key()
+
+    def test_no_tiers_is_noop(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("KATIB_ARTIFACT_DIR", raising=False)
+        monkeypatch.setattr(artifacts, "_cache_dir", lambda: None)
+        cache = ArtifactCache()
+        assert not cache.enabled()
+        assert cache.publish(_sig(), object()) == []
+        assert cache.fetch(_sig()) is None
+        assert cache.fetch_family(_sig()) == []
+
+
+class TestFamilyFetch:
+    def test_parent_link_collects_derived_programs(self, tiers):
+        cache, _ = tiers
+        step, args = _jit_step(), _args()
+        parent = _sig("mnist_trial", k=4)
+        derived = CompileSignature(
+            program="mnist_trial.step", shapes=parent.shapes, k=parent.k
+        )
+        cache.publish(
+            derived, serialize_compiled(step, args), parent=parent.key()
+        )
+        REGISTRY.reset()
+        cache.reset()  # drop the in-process adoption; force a tier scan
+        cache.configure(os.environ.get("KATIB_ARTIFACT_DIR"))
+        loaded = cache.fetch_family(parent)
+        assert [la.program for la in loaded] == ["mnist_trial.step"]
+        assert loaded[0].parent == parent.key()
+        # any family hit marks the request signature warm for first steps
+        assert REGISTRY.seen(parent)
+        # an unrelated signature collects nothing
+        assert cache.fetch_family(_sig("unrelated", k=8)) == []
+
+    def test_negative_cache_cleared_by_publish(self, tiers):
+        cache, _ = tiers
+        sig = _sig("neg.step")
+        m0 = _tier_total(obs.artifact_misses, "shared")
+        assert cache.fetch_family(sig) == []
+        assert cache.fetch_family(sig) == []  # negative-cached: no rescan
+        assert _tier_total(obs.artifact_misses, "shared") == m0 + 1
+        cache.publish(sig, serialize_compiled(_jit_step(), _args()))
+        assert cache.fetch_family(sig)
+
+
+class TestDispatchSeam:
+    def test_resolve_adopts_matching_artifact(self, tiers):
+        step, args = _jit_step(), _args()
+        artifacts.ARTIFACTS.configure(
+            str(tiers[1] / "shared")
+        )
+        artifacts.ARTIFACTS.publish(
+            _sig("seam.step"), serialize_compiled(step, args)
+        )
+        wrapped = resolve(step, program="seam.step")
+        assert wrapped.source == "jit"
+        np.testing.assert_array_equal(
+            np.asarray(wrapped(*args)), np.asarray(step(*args))
+        )
+        assert wrapped.source == "artifact"
+        assert hasattr(wrapped, "lower")  # costmodel still sees the jit fn
+
+    def test_resolve_stays_jit_without_aval_match(self, tiers):
+        step, args = _jit_step(), _args()
+        artifacts.ARTIFACTS.configure(str(tiers[1] / "shared"))
+        artifacts.ARTIFACTS.publish(
+            _sig("seam2.step"), serialize_compiled(step, args)
+        )
+        other_args = (args[0][:2], args[1])  # different avals
+        wrapped = resolve(step, program="seam2.step")
+        wrapped(*other_args)
+        assert wrapped.source == "jit"
+
+    def test_dispatch_failure_falls_back_to_jit(self, tiers):
+        step, args = _jit_step(), _args()
+
+        class Exploding:
+            args_info = ()
+
+            def __call__(self, *a):
+                raise RuntimeError("dead executable")
+
+        la = artifacts.LoadedArtifact(
+            sig_key=_sig("boom.step").key(),
+            program="boom.step",
+            compiled=Exploding(),
+            tier="local",
+            avals=artifacts._aval_list(args),
+            aval_key=artifacts.aval_digest(args),
+        )
+        artifacts.ARTIFACTS._adopt(la)
+        wrapped = resolve(step, program="boom.step")
+        np.testing.assert_array_equal(
+            np.asarray(wrapped(*args)), np.asarray(step(*args))
+        )
+        assert wrapped.source == "jit-fallback"
+        wrapped(*args)  # permanent: later calls stay on the jit path
+
+    def test_dummy_args_unwrap_args_info(self, tiers):
+        cache, tmp = tiers
+        step, args = _jit_step(), _args()
+        cache.publish(_sig("dummy.step"), serialize_compiled(step, args))
+        other = ArtifactCache()
+        other.configure(str(tmp / "shared"))
+        la = other.fetch(_sig("dummy.step"))
+        dummies = la.dummy_args()
+        assert [tuple(d.shape) for d in dummies] == [(4, 8), (8, 8)]
+        la(*dummies)  # a fetched executable that cannot run is useless
+
+
+class TestObservedPublish:
+    def test_publish_observed_links_parent_and_drains(self, tiers):
+        cache, tmp = tiers
+        artifacts.ARTIFACTS.configure(str(tmp / "shared"))
+        step, args = _jit_step(), _args()
+        sig = _sig("request", k=4)
+        artifacts.note_observed(
+            step, args, program="request.step", cost={"flops": 3.0}
+        )
+        assert publish_observed(sig) == 1
+        assert publish_observed(sig) == 0  # drained
+        rows = scan_dir(str(tmp / "shared"))
+        assert [r["program"] for r in rows] == ["request.step"]
+        data = (tmp / "shared" / rows[0]["name"]).read_bytes()
+        assert read_header(data)["parent"] == sig.key()
+
+    def test_prewarm_worker_publishes_once_then_fetches(self, tiers):
+        cache, tmp = tiers
+        artifacts.ARTIFACTS.configure(str(tmp / "shared"))
+        step, args = _jit_step(), _args()
+
+        def train_fn(ctx):  # pragma: no cover - never run here
+            pass
+
+        def twin(shared, k, mesh=None):
+            step(*args)  # "compile" the step program
+            artifacts.note_observed(step, args, program="worker.step")
+
+        attach_prewarm_fn(train_fn, twin)
+        req = PrewarmRequest(train_fn=train_fn, shared={"units": 8}, k=4)
+        worker = PrewarmWorker(registry=ShapeRegistry(), force=True)
+        try:
+            assert worker.submit(req)
+            assert worker.drain(timeout=30.0)
+            assert (worker.compiled, worker.published) == (1, 1)
+            # the re-run finds its own artifact: fetch, don't recompile
+            assert worker.submit(req)
+            assert worker.drain(timeout=30.0)
+            assert worker.fetched == 1
+            assert worker.compiled == 1  # no second twin run
+        finally:
+            worker.stop()
+        assert len(os.listdir(tmp / "shared")) == 1
+
+    def test_fetch_only_worker_never_compiles(self, tiers):
+        cache, tmp = tiers
+        artifacts.ARTIFACTS.configure(str(tmp / "shared"))
+        ran = threading.Event()
+
+        def train_fn(ctx):  # pragma: no cover
+            pass
+
+        attach_prewarm_fn(train_fn, lambda s, k, m=None: ran.set())
+        worker = PrewarmWorker(
+            registry=ShapeRegistry(), fetch_only=True, force=True
+        )
+        try:
+            assert worker.submit(PrewarmRequest(train_fn=train_fn, k=2))
+            assert worker.drain(timeout=10.0)
+            assert worker.compiled == 0 and not ran.is_set()
+        finally:
+            worker.stop()
+
+
+class TestFsckAndScan:
+    def _publish_one(self, tiers):
+        cache, tmp = tiers
+        cache.publish(_sig(), serialize_compiled(_jit_step(), _args()))
+        return tmp / "shared"
+
+    def test_is_artifact_dir(self, tiers, tmp_path):
+        shared = self._publish_one(tiers)
+        assert is_artifact_dir(str(shared))
+        assert not is_artifact_dir(str(tmp_path / "nope"))
+
+    def test_fsck_quarantines_corrupt_and_misaddressed(self, tiers):
+        shared = self._publish_one(tiers)
+        (shared / "deadbeef.katibx").write_bytes(b"garbage")
+        good = next(n for n in os.listdir(shared) if n != "deadbeef.katibx")
+        os.rename(shared / good, shared / ("0" * 64 + ".katibx"))
+        report = fsck_artifacts(str(shared), repair=False)
+        assert report.corrupt == ["deadbeef.katibx"]
+        assert report.misaddressed == ["0" * 64 + ".katibx"]
+        assert not report.consistent
+        report = fsck_artifacts(str(shared))
+        assert sorted(report.quarantined) == sorted(
+            ["deadbeef.katibx", "0" * 64 + ".katibx"]
+        )
+        assert report.consistent
+        # rerun on the repaired dir is clean
+        report = fsck_artifacts(str(shared))
+        assert report.consistent and not report.corrupt
+
+    def test_scan_dir_rows(self, tiers):
+        shared = self._publish_one(tiers)
+        (shared / ("1" * 64 + ".katibx")).write_bytes(b"garbage")
+        rows = {r["status"]: r for r in scan_dir(str(shared))}
+        assert rows["ok"]["program"] == "artifact_test.step"
+        assert rows["ok"]["k"] == 2
+        assert rows["ok"]["jax"] == env_fingerprint()["jax"]
+        assert rows["corrupt"]["name"] == "1" * 64 + ".katibx"
+
+
+class TestRegistryCompaction:
+    def _registry_file(self, tmp_path, monkeypatch):
+        import katib_tpu.compile.registry as registry_mod
+
+        monkeypatch.setattr(registry_mod, "_cache_dir", lambda: str(tmp_path))
+        return tmp_path / "shape_registry.jsonl"
+
+    def test_duplicate_rows_compact_on_open(self, tmp_path, monkeypatch):
+        path = self._registry_file(tmp_path, monkeypatch)
+        sig = _sig("compact.step")
+        row = {
+            "key": sig.key(), "program": sig.program, "k": sig.k,
+            "mesh": sig.mesh, "shapes": dict(sig.shapes),
+            "donation": sig.donation, "source": "trial",
+        }
+        lines = [dict(row), dict(row, cost={"flops": 1.0}),
+                 dict(row, cost={"flops": 2.0})]
+        path.write_text("".join(json.dumps(r) + "\n" for r in lines))
+        reg = ShapeRegistry()
+        assert reg.seen(sig)  # triggers load + compaction
+        # the freshest cost won the merge
+        assert reg.cost_of(sig) == {"flops": 2.0}
+        kept = [json.loads(l) for l in path.read_text().splitlines()]
+        assert len(kept) == 1
+        assert kept[0]["cost"] == {"flops": 2.0}
+        # no temp residue from the durable rewrite
+        assert os.listdir(tmp_path) == ["shape_registry.jsonl"]
+
+    def test_unique_rows_left_alone(self, tmp_path, monkeypatch):
+        path = self._registry_file(tmp_path, monkeypatch)
+        rows = [
+            {"key": _sig(f"p{i}.step").key(), "program": f"p{i}.step",
+             "k": 2, "mesh": "", "shapes": {}, "donation": True,
+             "source": "trial"}
+            for i in range(3)
+        ]
+        body = "".join(json.dumps(r) + "\n" for r in rows)
+        path.write_text(body)
+        reg = ShapeRegistry()
+        assert len(reg.signatures()) == 3
+        assert path.read_text() == body  # byte-identical: no rewrite
+
+    def test_torn_tail_with_dupes_heals(self, tmp_path, monkeypatch):
+        path = self._registry_file(tmp_path, monkeypatch)
+        sig = _sig("torn.step")
+        row = {
+            "key": sig.key(), "program": sig.program, "k": sig.k,
+            "mesh": sig.mesh, "shapes": dict(sig.shapes),
+            "donation": sig.donation, "source": "trial",
+        }
+        path.write_text(
+            json.dumps(row) + "\n" + json.dumps(row) + "\n" + '{"key": "to'
+        )
+        with pytest.warns(RuntimeWarning, match="torn"):
+            reg = ShapeRegistry()
+            assert reg.seen(sig)
+        # compaction rewrote the file: dupes merged, torn tail gone
+        kept = path.read_text()
+        assert kept.endswith("\n") and len(kept.splitlines()) == 1
+        assert ShapeRegistry().seen(sig)
