@@ -1252,6 +1252,164 @@ def _run_async_occupancy() -> None:
     sys.exit(3)
 
 
+def _pbt_child() -> None:
+    """Host-vs-on-device PBT A/B (parallel/pbt.py): the same digits
+    workload evolved by the host ``pbt`` suggester (one orchestrator trial
+    per member per generation, exploit = Orbax checkpoint copy) and by
+    ``pbt-ondevice`` (the whole population as one stacked cohort, selection
+    an on-device permutation inside the compiled generation step).  Equal
+    training compute per arm: population × generations × steps SGD steps
+    at the same batch.  Prints one tagged JSON line with generations/sec,
+    population-img/sec, and the speedup."""
+    import tempfile
+    import time as _time
+
+    from katib_tpu.core.types import (
+        AlgorithmSpec,
+        ExperimentSpec,
+        FeasibleSpace,
+        ObjectiveSpec,
+        ObjectiveType,
+        ParameterSpec,
+        ParameterType,
+    )
+    from katib_tpu.models.pbt_digits import pbt_digits_trial
+    from katib_tpu.orchestrator import Orchestrator
+
+    population = int(os.environ.get("BENCH_PBT_POPULATION", "16"))
+    generations = int(os.environ.get("BENCH_PBT_GENERATIONS", "10"))
+    steps = int(os.environ.get("BENCH_PBT_STEPS", "300"))
+    batch = 64  # pbt_digits default on both paths
+
+    def host_train(ctx):
+        # pin the per-round budget so both arms do identical training work
+        ctx.params.setdefault("steps_per_round", steps)
+        ctx.params.setdefault("batch", batch)
+        pbt_digits_trial(ctx)
+
+    def sweep(mode: str) -> dict:
+        settings = {
+            "n_population": str(population),
+            "truncation_threshold": "0.25",
+            "random_state": "7",
+        }
+        if mode == "ondevice":
+            settings["generations"] = str(generations)
+            settings["steps_per_generation"] = str(steps)
+            algo, max_trials, train_fn = "pbt-ondevice", population, pbt_digits_trial
+        else:
+            # host turnover: one pool of `population` trials per generation
+            algo, max_trials, train_fn = "pbt", population * generations, host_train
+        with tempfile.TemporaryDirectory() as wd:
+            if mode != "ondevice":
+                settings["suggestion_trial_dir"] = os.path.join(wd, "lineage")
+            spec = ExperimentSpec(
+                name=f"bench-pbt-{mode}",
+                objective=ObjectiveSpec(
+                    type=ObjectiveType.MAXIMIZE, objective_metric_name="accuracy"
+                ),
+                algorithm=AlgorithmSpec(name=algo, settings=settings),
+                parameters=[
+                    ParameterSpec(
+                        "lr", ParameterType.DOUBLE, FeasibleSpace(min=0.005, max=0.5)
+                    )
+                ],
+                train_fn=train_fn,
+                parallel_trial_count=population,
+                max_trial_count=max_trials,
+            )
+            t0 = _time.perf_counter()
+            exp = Orchestrator(workdir=wd).run(spec)
+            import jax
+
+            _device_barrier(jax)
+            elapsed = _time.perf_counter() - t0
+        settled = sum(1 for t in exp.trials.values() if t.condition.is_terminal())
+        metric_name = spec.objective.objective_metric_name
+        best = max(
+            (
+                m.value
+                for t in exp.trials.values()
+                if t.observation is not None
+                for m in [t.observation.get(metric_name)]
+                if m is not None
+            ),
+            default=None,
+        )
+        return {
+            "mode": mode,
+            "trials": settled,
+            "generations": generations,
+            "elapsed_secs": round(elapsed, 3),
+            "generations_per_sec": round(generations / elapsed, 4),
+            "population_imgs_per_sec": round(
+                population * generations * steps * batch / elapsed, 1
+            ),
+            "best_accuracy": round(float(best), 4) if best is not None else None,
+            "condition": exp.condition.value,
+        }
+
+    host = sweep("host")
+    ondevice = sweep("ondevice")
+    result = {
+        "benchmark": "pbt_ondevice",
+        "platform": "cpu",
+        "population": population,
+        "generations": generations,
+        "steps_per_generation": steps,
+        "batch": batch,
+        "host": host,
+        "ondevice": ondevice,
+        "speedup": round(
+            ondevice["generations_per_sec"] / host["generations_per_sec"], 3
+        ),
+        "note": (
+            "same digits workload and per-member compute on CPU; host pays "
+            "per-trial dispatch + Orbax checkpoint copies per generation, "
+            "on-device runs the population as one compiled scan with "
+            "selection as an in-program permutation"
+        ),
+    }
+    print(_RESULT_TAG + json.dumps(result))
+
+
+def _run_pbt() -> None:
+    """Parent side of ``--pbt``: run the host-vs-on-device PBT A/B in a
+    scrubbed-env CPU child and print its JSON line."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the relay
+    env.pop("KATIB_ASYNC_ORCH", None)
+    env.pop("KATIB_PBT_ONDEVICE", None)  # the algorithm name drives each arm
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--pbt-child"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env=env,
+    )
+    try:
+        out, err = proc.communicate(timeout=1800)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.communicate()
+        print("bench: pbt child timed out", file=sys.stderr)
+        sys.exit(3)
+    for line in (out or "").splitlines():
+        if line.startswith(_RESULT_TAG):
+            try:
+                result = json.loads(line[len(_RESULT_TAG):])
+            except json.JSONDecodeError:
+                continue
+            print(json.dumps(result))
+            return
+    print(
+        f"bench: pbt child failed rc={proc.returncode}:\n" + (err or "")[-2000:],
+        file=sys.stderr,
+    )
+    sys.exit(3)
+
+
 def _run_attempt(
     deadline: float, env: dict | None = None
 ) -> tuple[int, dict | None, str]:
@@ -1316,6 +1474,12 @@ def main() -> None:
         return
     if "--async-occupancy" in sys.argv:
         _run_async_occupancy()
+        return
+    if "--pbt-child" in sys.argv:
+        _pbt_child()
+        return
+    if "--pbt" in sys.argv:
+        _run_pbt()
         return
 
     retries = int(os.environ.get("BENCH_RETRIES", "3"))

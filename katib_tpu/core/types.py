@@ -743,6 +743,12 @@ class ExperimentSpec:
     # (KATIB_ASYNC_ORCH=0 semantics) instead of dying. 0 = never restart,
     # fall back on the first crash/stall.
     loop_restart_budget: int = 3
+    # On-device PBT escape hatch (pbt-ondevice algorithm, parallel/pbt.py):
+    # None defers to the algorithm's `on_device` setting (default ON);
+    # False forces the host checkpoint-exchange path, True forces the
+    # fused on-device generation loop.  KATIB_PBT_ONDEVICE env wins over
+    # both (operator kill switch without editing specs).
+    pbt_ondevice: bool | None = None
     # Speculative straggler re-dispatch: when a member runs past
     # straggler_factor x the median settle time it is re-submitted as a
     # singleton; first settle wins (exactly-once journal keying), the rival

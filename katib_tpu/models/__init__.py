@@ -1,5 +1,6 @@
 from katib_tpu.models.data import Dataset, load_cifar10, load_mnist  # noqa: F401
 from katib_tpu.models.mnist import MLP, SmallCNN, mnist_trial, train_classifier  # noqa: F401
+from katib_tpu.models.pbt_digits import pbt_digits_cohort, pbt_digits_trial  # noqa: F401
 from katib_tpu.models.pbt_toy import optimal_lr, pbt_toy_trial  # noqa: F401
 from katib_tpu.models.transformer import (  # noqa: F401
     TransformerLM,

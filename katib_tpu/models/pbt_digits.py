@@ -111,3 +111,269 @@ def pbt_digits_trial(ctx) -> None:
         },
         step,
     )
+
+
+# -- on-device PBT twin -------------------------------------------------------
+
+
+def _member_checkpointers(cctx):
+    from katib_tpu.utils.checkpoint import TrialCheckpointer
+
+    return [
+        TrialCheckpointer(d) if d else None for d in cctx.checkpoint_dirs
+    ]
+
+
+def pbt_digits_cohort(cctx) -> None:
+    """The on-device PBT twin of :func:`pbt_digits_trial`: the whole
+    population trains, scores, selects, clones, and perturbs as chunked
+    dispatches of ONE compiled program (``parallel/pbt.py``), with host
+    round-trips only at generation boundaries (scores/lineage fetch + the
+    per-member Orbax checkpoints that make drain/resume lossless).
+
+    Launched by the ``pbt-ondevice`` suggester, which stamps the shared
+    ``pbt_*`` assignments (space JSON, generation count/length, truncation,
+    resample probability, seed) on every member.  Without them (a plain
+    cohort experiment over this trial fn) it raises, and ``run_cohort``
+    falls back to serial per-member execution — the host path.
+
+    Checkpoint schema stays a superset of the host trial's
+    (``params``/``velocity``/``step`` + ``hypers``/``generation``), so a
+    drained on-device member can resume through EITHER path.  Scores are
+    test-set accuracy (maximize), matching the host trial's report.
+    """
+    import time as _time
+    from concurrent.futures import ThreadPoolExecutor
+
+    from katib_tpu import costmodel
+    from katib_tpu.parallel.pbt import (
+        decode_member_hypers,
+        encode_hypers,
+        make_pbt_generation_step,
+        specs_from_json,
+    )
+    from katib_tpu.parallel.train import stack_pytrees
+    from katib_tpu.suggest.pbt import GENERATION_LABEL, PARENT_LABEL
+    from katib_tpu.utils import observability as obs
+    from katib_tpu.utils import tracing
+
+    space_json = cctx.shared("pbt_space", None)
+    if space_json is None:
+        raise ValueError(
+            "pbt_digits_cohort needs the pbt-ondevice suggester's pbt_space "
+            "assignment (plain cohorts fall back to the serial trial path)"
+        )
+    specs = specs_from_json(space_json)
+    k = len(cctx)
+    p = cctx.padded_size
+    generations = int(cctx.shared("pbt_generations", 8))
+    steps = int(cctx.shared("pbt_steps_per_generation", 60))
+    truncation = float(cctx.shared("pbt_truncation", 0.25))
+    resample_p = cctx.shared("pbt_resample_p", None)
+    resample_p = float(resample_p) if resample_p is not None else None
+    seed = int(cctx.shared("pbt_seed", 0))
+    batch = int(cctx.shared("batch", 64))
+
+    ds = _cached_digits(1400, 397)
+    x_train = jnp.asarray(ds.x_train.reshape(len(ds.x_train), -1))
+    y_train = jnp.asarray(ds.y_train)
+    data = cctx.place_shared((x_train, y_train))
+    eval_batch = cctx.place_shared(
+        (
+            jnp.asarray(ds.x_test.reshape(len(ds.x_test), -1)),
+            jnp.asarray(ds.y_test),
+        )
+    )
+    n_train = len(ds.x_train)
+    d_in = int(x_train.shape[1])
+
+    # restore per-member state at a COMMON generation (drain saves every
+    # member at the same boundary; a member missing that step restores its
+    # newest earlier one and replays — the generation stream is a pure
+    # function of (seed, g), so the replay is deterministic)
+    ckptrs = _member_checkpointers(cctx)
+    latest = [c.latest_step() if c is not None else None for c in ckptrs]
+    start_gen = 0
+    restore_at = None
+    if all(s is not None for s in latest) and latest:
+        restore_at = min(latest)
+        start_gen = restore_at + 1
+
+    member_states = []
+    params_list = []
+    for i in range(k):
+        restored = None
+        if restore_at is not None and ckptrs[i] is not None:
+            steps_i = ckptrs[i].all_steps()
+            at = restore_at if restore_at in steps_i else max(
+                (s for s in steps_i if s <= restore_at), default=None
+            )
+            restored = ckptrs[i].restore(step=at) if at is not None else None
+        if restored is not None:
+            state_i, _ = restored
+            member_states.append(
+                {
+                    "params": jax.tree_util.tree_map(
+                        jnp.asarray, state_i["params"]
+                    ),
+                    "velocity": jax.tree_util.tree_map(
+                        jnp.asarray, state_i["velocity"]
+                    ),
+                    "step": jnp.asarray(int(state_i["step"]), jnp.int32),
+                }
+            )
+            hyp = state_i.get("hypers")
+            if hyp is not None:
+                params_list.append(
+                    decode_member_hypers(
+                        specs, {n: np.asarray([float(v)]) for n, v in hyp.items()}, 0
+                    )
+                )
+            else:
+                params_list.append(cctx.params_list[i])
+        else:
+            # identical init across members (host trial parity: PRNGKey(0))
+            prm = _init_params(jax.random.PRNGKey(0), d_in, 10)
+            member_states.append(
+                {
+                    "params": prm,
+                    "velocity": jax.tree_util.tree_map(jnp.zeros_like, prm),
+                    "step": jnp.asarray(0, jnp.int32),
+                }
+            )
+            params_list.append(cctx.params_list[i])
+
+    # ghost rows repeat member 0 (inert; never win, never cloned)
+    member_states += [member_states[0]] * (p - k)
+    states = cctx.place_members(stack_pytrees(member_states))
+    hypers = cctx.place_members(encode_hypers(specs, params_list, p))
+
+    def member_step(state, hrow, mb):
+        x, y = mb
+        lr = hrow["lr"]
+        grads = jax.grad(_loss)(state["params"], x, y)
+        velocity = jax.tree_util.tree_map(
+            lambda v, g: 0.9 * v + g, state["velocity"], grads
+        )
+        params = jax.tree_util.tree_map(
+            lambda pp, v: pp - lr * v, state["params"], velocity
+        )
+        return {"params": params, "velocity": velocity, "step": state["step"] + 1}
+
+    def member_eval(state, ev):
+        x, y = ev
+        return (jnp.argmax(_logits(state["params"], x), axis=-1) == y).mean()
+
+    gen_step = make_pbt_generation_step(
+        member_step,
+        member_eval,
+        specs=specs,
+        k=k,
+        truncation=truncation,
+        resample_p=resample_p,
+        mesh=cctx.cohort_mesh,
+    )
+
+    obs.pbt_onchip.set(1.0)
+    try:
+        for g in range(start_gen, generations):
+            # per-generation streams are pure functions of (seed, g): a
+            # same-seed rerun is bit-stable and a resumed run replays the
+            # exact generation it drained out of
+            idx = jnp.asarray(
+                np.random.default_rng((seed, g)).integers(
+                    0, n_train, size=(steps, batch)
+                ),
+                jnp.int32,
+            )
+            key_g = jax.random.fold_in(jax.random.PRNGKey(seed), g)
+            if g == start_gen:
+                costmodel.observe_program(
+                    ("pbt_digits.generation", k, p, steps, batch, _HIDDEN),
+                    gen_step,
+                    (states, hypers, key_g, idx, data, eval_batch),
+                    program="pbt_digits_cohort.generation",
+                    steps=steps,
+                    dtype="f32",
+                )
+            started = _time.perf_counter()
+            states, hypers, _key, scores, parent, exploited = gen_step(
+                states, hypers, key_g, idx, data, eval_batch
+            )
+            # generation boundary: the ONLY host transfers in the loop
+            scores_np = np.asarray(scores)[:k]
+            parent_np = np.asarray(parent)[:k].astype(int)
+            expl_np = np.asarray(exploited)[:k].astype(bool)
+            n_exploits = int(expl_np.sum())
+            n_winners = len(set(parent_np[expl_np]))
+            obs.pbt_generations.inc()
+            if n_exploits:
+                obs.pbt_exploits.inc(float(n_exploits))
+            tracing.record_span(
+                "pbt-generation",
+                _time.perf_counter() - started,
+                generation=g,
+                exploits=n_exploits,
+                winners=n_winners,
+                perturbs=k - n_exploits,
+                population=k,
+            )
+            # lineage, exactly as the host path labels next-gen trials:
+            # exploiters point at their winner, explorers at themselves
+            for i, t in enumerate(cctx.members):
+                t.spec.labels[GENERATION_LABEL] = str(g + 1)
+                t.spec.labels[PARENT_LABEL] = (
+                    cctx.members[parent_np[i]].name if expl_np[i] else t.name
+                )
+            # an exploited member's row now carries its winner's state, so
+            # report the score of what the member actually holds (a
+            # diverged member heals through the exploit path instead of
+            # settling Permanent-failed on a non-finite row)
+            report_acc = scores_np[parent_np]
+            cont = cctx.report(
+                step=g,
+                accuracy=report_acc,
+                pbt_generation=np.full(k, float(g + 1)),
+                pbt_parent=parent_np.astype(float),
+                pbt_exploit=expl_np.astype(float),
+            )
+            # stacked-population checkpoint at the generation boundary:
+            # drain/resume re-enters the loop at start_gen = g + 1 with
+            # zero lost members.  The member saves overlap in a thread
+            # pool — each Orbax commit is fsync/rename-bound, and serial
+            # saves would cost more than the generation dispatch itself.
+            host_states = jax.device_get(states)
+            host_hypers = {n: np.asarray(v) for n, v in hypers.items()}
+
+            def _save_member(i: int) -> None:
+                row = jax.tree_util.tree_map(lambda x: x[i], host_states)
+                ckptrs[i].save(
+                    {
+                        "params": row["params"],
+                        "velocity": row["velocity"],
+                        "step": np.asarray(int(row["step"])),
+                        "hypers": {
+                            n: np.float32(v[i]) for n, v in host_hypers.items()
+                        },
+                        "generation": np.asarray(g),
+                    },
+                    g,
+                )
+
+            with ThreadPoolExecutor(max_workers=min(8, k)) as pool:
+                # list() re-raises the first member-save failure
+                list(
+                    pool.map(
+                        _save_member,
+                        [i for i in range(k) if ckptrs[i] is not None],
+                    )
+                )
+            if not cont or cctx.should_stop():
+                return
+    finally:
+        obs.pbt_onchip.set(0.0)
+
+
+from katib_tpu.runner.cohort import attach_cohort_fn  # noqa: E402
+
+attach_cohort_fn(pbt_digits_trial, pbt_digits_cohort)

@@ -8,6 +8,16 @@ from katib_tpu.parallel.mesh import (  # noqa: F401
     replicated,
     shard_batch,
 )
+from katib_tpu.parallel.pbt import (  # noqa: F401
+    HyperSpec,
+    decode_member_hypers,
+    encode_hypers,
+    exploit_explore,
+    make_pbt_generation_step,
+    specs_from_json,
+    specs_from_parameters,
+    specs_to_json,
+)
 from katib_tpu.parallel.train import (  # noqa: F401
     TrainState,
     accuracy,

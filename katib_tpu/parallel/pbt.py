@@ -1,0 +1,400 @@
+"""On-device Population Based Training: exploit/explore as an array permutation.
+
+The reference Katib's PBT moves checkpoints between pods with a directory
+copy on a RWX PVC and reassigns hyperparameters through a host-side
+controller round-trip per generation (``pbt/service.py:259-268``); our host
+parity port (``suggest/pbt.py``) keeps that shape — one trial dispatch, one
+Orbax save, one ``shutil.copytree`` per member per generation.  But the
+cohort machinery (PRs 3-8) already holds the entire population as ONE
+stacked ``[K, ...]`` pytree on device.  This module closes the loop the way
+Podracer puts everything on the learner (arxiv 2104.06272): a full PBT
+generation — train T steps, score, truncation-select, clone winners,
+perturb hyperparameters — is one jitted dispatch with zero host transfers
+inside it.  "Checkpoint exchange" becomes ``jnp.take`` over the member
+axis (a collective permutation when the cohort is sharded over the
+``trial`` mesh axis); hyperparameter perturbation rides a threaded
+``jax.random`` key in-kernel.
+
+Selection semantics mirror ``PbtSuggester`` (host reference):
+
+- scores are scaled so higher is better; ``lo, hi`` are the
+  ``(truncation, 1 - truncation)`` quantiles (``jnp.quantile`` matches
+  ``np.quantile``'s linear interpolation, so device and host agree on the
+  cut points bit-for-bit on equal inputs);
+- the bottom quantile *exploits*: ``n_exploit = round_half_up(K * trunc)``
+  members with score < lo (floored to 1 whenever anyone is below the
+  quantile — the host's small-population floor fix) each clone a uniformly
+  random winner (score >= hi): state AND hyperparameters;
+- everyone else *explores*: each hyperparameter is perturbed x0.8/x1.2
+  (clipped to bounds, rounded for ints, neighbor-stepped mod N for
+  categorical/discrete) — or, with ``resample_probability`` set, is
+  independently resampled from the prior with probability p and kept
+  as-is otherwise, exactly the host ``_generate`` branch;
+- ghost rows (mesh padding / shape buckets, rows ``[k:]``) never win,
+  never exploit, and keep their hyperparameters, so bucketed cohorts share
+  the same executable as exact-width ones;
+- a member whose eval score goes non-finite ranks at the bottom and is
+  overwritten by a winner on the next selection — divergence self-heals
+  through the exploit path instead of freezing a lane.
+
+Hyperparameters live as a ``{name: [P] float32}`` dict operand
+(categorical/discrete carried in index space); the encode/decode helpers
+below translate to/from native parameter dicts at generation boundaries
+only.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from dataclasses import dataclass
+from typing import Any, Callable, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from katib_tpu.parallel.mesh import replicated, trial_axis_size, trial_sharding
+
+# stands in for -inf so quantile interpolation over a pool containing a
+# diverged member stays finite (x * inf = nan would poison the cut points)
+_NEG = -1e30
+
+
+def _round_half_up(x: float) -> int:
+    return int(math.floor(x + 0.5))
+
+
+# -- search-space description (host <-> device boundary) ----------------------
+
+
+@dataclass(frozen=True)
+class HyperSpec:
+    """Device-side view of one parameter: enough to perturb/resample it
+    in-kernel and decode it back to a native value at the boundary.
+    ``kind`` is the ParameterType value; categorical/discrete carry their
+    value list for index-space decode."""
+
+    name: str
+    kind: str  # "double" | "int" | "discrete" | "categorical"
+    lo: float = 0.0
+    hi: float = 1.0
+    log: bool = False
+    values: tuple = ()
+
+    @property
+    def categorical(self) -> bool:
+        return self.kind in ("discrete", "categorical")
+
+    @property
+    def n_choices(self) -> int:
+        return len(self.values)
+
+
+def specs_from_parameters(parameters: Sequence[Any]) -> tuple[HyperSpec, ...]:
+    """Build the device-side space description from ``ParameterSpec``s."""
+    out = []
+    for p in parameters:
+        kind = p.type.value
+        f = p.feasible
+        if kind in ("double", "int"):
+            out.append(
+                HyperSpec(
+                    name=p.name,
+                    kind=kind,
+                    lo=float(f.min),
+                    hi=float(f.max),
+                    log=bool(f.is_log_scaled()),
+                )
+            )
+        else:
+            out.append(
+                HyperSpec(name=p.name, kind=kind, values=tuple(f.list or ()))
+            )
+    return tuple(out)
+
+
+def specs_to_json(specs: Sequence[HyperSpec]) -> str:
+    return json.dumps(
+        [
+            {
+                "name": s.name,
+                "kind": s.kind,
+                "lo": s.lo,
+                "hi": s.hi,
+                "log": s.log,
+                "values": list(s.values),
+            }
+            for s in specs
+        ]
+    )
+
+
+def specs_from_json(payload: str) -> tuple[HyperSpec, ...]:
+    return tuple(
+        HyperSpec(
+            name=d["name"],
+            kind=d["kind"],
+            lo=float(d.get("lo", 0.0)),
+            hi=float(d.get("hi", 1.0)),
+            log=bool(d.get("log", False)),
+            values=tuple(d.get("values", ())),
+        )
+        for d in json.loads(payload)
+    )
+
+
+def encode_hypers(
+    specs: Sequence[HyperSpec],
+    params_list: Sequence[Mapping[str, Any]],
+    padded_size: int | None = None,
+) -> dict[str, jnp.ndarray]:
+    """Member parameter dicts -> ``{name: [P] float32}`` device operands.
+    Categorical/discrete values are carried as their list index.  Ghost
+    rows (``padded_size > len(params_list)``) repeat member 0."""
+    k = len(params_list)
+    p = padded_size if padded_size is not None else k
+    out: dict[str, jnp.ndarray] = {}
+    for s in specs:
+        vals = []
+        for i in range(p):
+            # ghost rows repeat member 0 (inert but finite — same
+            # convention as CohortContext.stacked)
+            v = params_list[i if i < k else 0][s.name]
+            if s.categorical:
+                try:
+                    vals.append(float(list(s.values).index(_cat_cast(s, v))))
+                except ValueError:
+                    vals.append(0.0)
+            else:
+                vals.append(float(v))
+        out[s.name] = jnp.asarray(vals, dtype=jnp.float32)
+    return out
+
+
+def _cat_cast(s: HyperSpec, v: Any):
+    """Match a raw value against the spec's value list the way
+    ``ParameterSpec.cast`` does for discrete (numeric tolerance)."""
+    if s.kind == "discrete":
+        fv = float(v)
+        for item in s.values:
+            if math.isclose(float(item), fv, rel_tol=1e-12, abs_tol=1e-12):
+                return item
+        return v
+    return v
+
+
+def decode_member_hypers(
+    specs: Sequence[HyperSpec], hypers: Mapping[str, Any], i: int
+) -> dict[str, Any]:
+    """Row ``i`` of the device hyper arrays -> a native parameter dict."""
+    out: dict[str, Any] = {}
+    for s in specs:
+        v = float(jnp.asarray(hypers[s.name])[i])
+        if s.categorical:
+            out[s.name] = s.values[int(round(v)) % max(1, s.n_choices)]
+        elif s.kind == "int":
+            out[s.name] = int(round(v))
+        else:
+            out[s.name] = v
+    return out
+
+
+# -- the selection kernel -----------------------------------------------------
+
+
+def exploit_explore(
+    key: jax.Array,
+    scores: jnp.ndarray,
+    hypers: Mapping[str, jnp.ndarray],
+    *,
+    specs: Sequence[HyperSpec],
+    k: int,
+    truncation: float,
+    resample_p: float | None = None,
+):
+    """One truncation-selection + perturbation step, fully on device.
+
+    ``scores``: ``[P]`` (higher is better; rows ``[k:]`` are ghosts).
+    ``hypers``: ``{name: [P]}`` (categorical in index space).
+
+    Returns ``(parent, new_hypers, exploited, stats)``:
+    ``parent[i]`` is the member whose state row ``i`` should take
+    (``i`` itself for explorers/ghosts) — apply with
+    ``jax.tree_util.tree_map(lambda x: jnp.take(x, parent, axis=0), states)``;
+    ``exploited`` is the ``[P]`` bool exploit mask; ``stats`` carries the
+    quantile cut points and winner mask for telemetry.
+
+    Jit-safe with ``specs``/``k``/``truncation``/``resample_p`` static
+    (close over them or mark them static).
+    """
+    p = scores.shape[0]
+    if not 0 < k <= p:
+        raise ValueError(f"k={k} out of range for padded size {p}")
+    valid = jnp.arange(p) < k
+    finite = jnp.isfinite(scores)
+    s = jnp.where(valid & finite, scores, _NEG)
+
+    # cut points over the k REAL members (static slice excludes ghosts);
+    # linear-interpolation quantile, bit-identical to the host np.quantile
+    pool = s[:k]
+    lo = jnp.quantile(pool, truncation)
+    hi = jnp.quantile(pool, 1.0 - truncation)
+
+    below = valid & (s < lo)
+    # host parity incl. the small-population fix: round half-up, floor of 1
+    # whenever anyone actually fell below the quantile
+    n_exploit = _round_half_up(k * truncation)
+    n_exploit_dyn = jnp.where(
+        below.any(), jnp.maximum(jnp.int32(n_exploit), 1), jnp.int32(n_exploit)
+    )
+    # rank ascending among valid members (ghosts pushed past the end) so
+    # "the n_exploit members below lo" is deterministic: worst-first
+    rank_key = jnp.where(valid, s, jnp.inf)
+    order = jnp.argsort(rank_key)
+    rank = jnp.argsort(order)
+    exploited = below & (rank < n_exploit_dyn)
+
+    winners = valid & finite & (s >= hi)
+    any_winner = winners.any()
+    exploited = exploited & any_winner
+
+    key_sel, key_perturb = jax.random.split(key)
+    logits = jnp.where(winners, 0.0, -jnp.inf)
+    # ghosts draw too (vmapped over the full padded axis) but their rows
+    # are discarded by the exploit mask — shapes stay bucket-stable
+    member_keys = jax.random.split(key_sel, p)
+    choice = jax.vmap(lambda mk: jax.random.categorical(mk, logits))(member_keys)
+    self_idx = jnp.arange(p)
+    parent = jnp.where(exploited, choice, self_idx)
+
+    explore = valid & ~exploited
+    new_hypers: dict[str, jnp.ndarray] = {}
+    for j, spec in enumerate(specs):
+        v = hypers[spec.name]
+        kj = jax.random.fold_in(key_perturb, j)
+        k_flip, k_draw = jax.random.split(kj)
+        if resample_p is None:
+            # perturb: x0.8 / x1.2 clipped (linear, like the host _perturb),
+            # or +-1 neighbor step mod N in index space
+            flip = jax.random.bernoulli(k_flip, 0.5, (p,))
+            if spec.categorical:
+                step = jnp.where(flip, -1.0, 1.0)
+                perturbed = jnp.mod(jnp.round(v) + step, float(max(1, spec.n_choices)))
+            else:
+                factor = jnp.where(flip, 0.8, 1.2)
+                perturbed = jnp.clip(v * factor, spec.lo, spec.hi)
+                if spec.kind == "int":
+                    perturbed = jnp.round(perturbed)
+        else:
+            # resample-with-probability-p: fresh prior draw or keep AS-IS
+            # (the host branch never perturbs in this mode)
+            take_new = jax.random.uniform(k_flip, (p,)) < resample_p
+            u = jax.random.uniform(k_draw, (p,))
+            if spec.categorical:
+                drawn = jnp.floor(u * spec.n_choices)
+                drawn = jnp.clip(drawn, 0, max(0, spec.n_choices - 1))
+            elif spec.log:
+                drawn = jnp.exp(
+                    math.log(spec.lo) + u * (math.log(spec.hi) - math.log(spec.lo))
+                )
+            else:
+                drawn = spec.lo + u * (spec.hi - spec.lo)
+            if spec.kind == "int":
+                drawn = jnp.round(drawn)
+            perturbed = jnp.where(take_new, drawn, v)
+        # exploiters inherit the winner's hyperparameters VERBATIM
+        # (pre-perturb — standard PBT and the host's exploit branch)
+        new_hypers[spec.name] = jnp.where(
+            exploited,
+            jnp.take(v, parent),
+            jnp.where(explore, perturbed, v),
+        ).astype(v.dtype)
+
+    stats = {
+        "lo": lo,
+        "hi": hi,
+        "n_exploit": n_exploit_dyn,
+        "winners": winners,
+    }
+    return parent, new_hypers, exploited, stats
+
+
+# -- the generation step ------------------------------------------------------
+
+
+def make_pbt_generation_step(
+    member_train_step: Callable,
+    member_eval_fn: Callable,
+    *,
+    specs: Sequence[HyperSpec],
+    k: int,
+    truncation: float,
+    resample_p: float | None = None,
+    mesh: Any = None,
+    donate: bool = True,
+) -> Callable:
+    """Build the fused generation step: T train steps x eval x selection x
+    clone x perturb as ONE jitted program.
+
+    ``member_train_step(state, hypers_row, batch) -> state`` is one member's
+    SGD step (``hypers_row`` is ``{name: scalar}``); ``member_eval_fn(state,
+    eval_batch) -> scalar`` scores one member (higher is better; apply the
+    objective sign before calling).  Both are vmapped over the leading
+    member axis.
+
+    The returned ``gen_step(states, hypers, key, batch_idx, data,
+    eval_batch)`` runs ``batch_idx.shape[0]`` training steps under
+    ``lax.scan`` (per-step minibatches gathered ON DEVICE from the resident
+    ``data`` by index — no host transfer inside the generation), evaluates,
+    selects, permutes member states via ``jnp.take`` over the member axis,
+    and perturbs hyperparameters with the threaded key.  Returns
+    ``(states, hypers, key, scores, parent, exploited)``.  The carried
+    population (``states``/``hypers``/``key``) is donated so G generations
+    reuse the same device buffers as chunked dispatches of one cached
+    executable.
+
+    With a ``mesh`` carrying a ``trial`` axis, states/hypers shard their
+    member dimension over it and the exploit ``take`` lowers to a
+    collective permutation; everything else is replicated.
+    """
+    vstep = jax.vmap(member_train_step, in_axes=(0, 0, None))
+    veval = jax.vmap(member_eval_fn, in_axes=(0, None))
+
+    def gen_step(states, hypers, key, batch_idx, data, eval_batch):
+        def body(carry, idx):
+            st = carry
+            batch = jax.tree_util.tree_map(
+                lambda d: jnp.take(d, idx, axis=0), data
+            )
+            st = vstep(st, hypers, batch)
+            return st, None
+
+        states, _ = lax.scan(body, states, batch_idx)
+        scores = veval(states, eval_batch)
+        key, sel_key = jax.random.split(key)
+        parent, new_hypers, exploited, _stats = exploit_explore(
+            sel_key,
+            scores,
+            hypers,
+            specs=specs,
+            k=k,
+            truncation=truncation,
+            resample_p=resample_p,
+        )
+        states = jax.tree_util.tree_map(
+            lambda x: jnp.take(x, parent, axis=0), states
+        )
+        return states, new_hypers, key, scores, parent, exploited
+
+    donate_args = (0, 1, 2) if donate else ()
+    if mesh is None or trial_axis_size(mesh) <= 1:
+        return jax.jit(gen_step, donate_argnums=donate_args)
+    member = trial_sharding(mesh)
+    shared = replicated(mesh)
+    return jax.jit(
+        gen_step,
+        in_shardings=(member, member, shared, shared, shared, shared),
+        out_shardings=(member, member, shared, member, member, member),
+        donate_argnums=donate_args,
+    )

@@ -380,6 +380,9 @@ def experiment_spec_from_dict(data: Mapping[str, Any]) -> ExperimentSpec:
         loop_restart_budget=int(spec.get("loopRestartBudget", 3)),
         speculative_redispatch=bool(spec.get("speculativeRedispatch", False)),
         straggler_factor=float(spec.get("stragglerFactor", 4.0)),
+        pbt_ondevice=(
+            bool(spec["pbtOnDevice"]) if spec.get("pbtOnDevice") is not None else None
+        ),
     )
 
 

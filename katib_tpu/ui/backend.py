@@ -664,6 +664,8 @@ async function counters(){
     (tot('katib_drain_requested')?' · <b>DRAINING</b>':'')+
     (tot('katib_suggester_errors_total')?` · suggester errors: ${tot('katib_suggester_errors_total')}`:'')+
     (tot('katib_cohort_executed_total')?` · cohorts: ${tot('katib_cohort_executed_total')}`:'')+
+    (tot('katib_pbt_generations_total')?
+      ` · pbt: ${tot('katib_pbt_generations_total')} gens / ${tot('katib_pbt_exploits_total')} exploits${tot('katib_pbt_onchip')?' <b>ON-CHIP</b>':''}`:'')+
     ((tot('katib_compile_cache_hits_total')||tot('katib_compile_cache_misses_total'))?
       ` · compile cache: ${tot('katib_compile_cache_hits_total')} warm / ${tot('katib_compile_cache_misses_total')} cold`:'')+
     (tot('katib_prewarm_compiles_total')?` · prewarmed: ${tot('katib_prewarm_compiles_total')}`:'')+

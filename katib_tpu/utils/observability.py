@@ -511,6 +511,24 @@ cohort_devices = REGISTRY.gauge(
     "Devices the most recent cohort's trial axis spanned "
     "(1 = single-device vmap, D = SPMD-sharded member dimension)",
 )
+
+# -- on-device Population Based Training (parallel/pbt.py) --------------------
+
+pbt_generations = REGISTRY.counter(
+    "katib_pbt_generations_total",
+    "PBT generations executed (train + select + clone + perturb rounds)",
+)
+pbt_exploits = REGISTRY.counter(
+    "katib_pbt_exploits_total",
+    "PBT exploit events: members overwritten by a top-quantile winner's "
+    "state + hyperparameters",
+)
+pbt_onchip = REGISTRY.gauge(
+    "katib_pbt_onchip",
+    "1 while a PBT population is evolving on device (fused generation "
+    "dispatches, zero host transfers inside a generation); 0 when the "
+    "host checkpoint-exchange path is active",
+)
 compile_cache_enabled = REGISTRY.gauge(
     "katib_compile_cache_enabled",
     "1 when the persistent XLA compilation cache is wired "

@@ -697,6 +697,28 @@ class TestPbt(object):
         # someone exploited a winner: params equal to a winner's params
         assert exploit_children, "expected at least one exploit child of a top member"
 
+    def test_small_count_still_exploits(self, tmp_path):
+        """Regression: ``n_exploit = int(count * truncation)`` floored to 0
+        whenever count < 1/truncation — a small population / partial refill
+        silently degenerated into random search (no member ever cloned a
+        winner).  Rounds half-up with a floor of 1 when anyone is below
+        the quantile."""
+        spec = self._spec(tmp_path)
+        s = make_suggester(spec)
+        # pool of 8 scored members, segment a partial refill of count=3:
+        # old code: int(3 * 0.25) = 0 exploiters, forever
+        exp = new_exp(spec)
+        gen0 = s.get_suggestions(exp, 8)
+        for i, p in enumerate(gen0):
+            complete_trial(exp, p, float(i))
+        s._sync(exp)
+        exploit, explore, upper = s._segment(s.pool_current, 3)
+        assert len(exploit) == 1
+        assert exploit[0].score < min(j.score for j in upper)
+        # round half-up: count=6 at 0.25 -> 1.5 -> 2 exploiters (old: 1)
+        exploit6, _, _ = s._segment(s.pool_current, 6)
+        assert len(exploit6) == 2
+
     def test_failed_members_requeued(self, tmp_path):
         spec = self._spec(tmp_path)
         s = make_suggester(spec)
