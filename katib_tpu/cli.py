@@ -1780,6 +1780,34 @@ def cmd_lint(args: argparse.Namespace) -> int:
     return report.exit_code
 
 
+def cmd_sim(args: argparse.Namespace) -> int:
+    """Virtual-time scale simulation: run the real orchestrator stack
+    (async loops, supervisor, journal, suggester) against a modeled trial
+    executor under a discrete-event clock, inject the scenario's fault
+    schedule, then gate on the journal-replay invariants — see
+    ``katib_tpu/sim/``.  Exit 0 on PASS (zero violations)."""
+    from katib_tpu.sim.runner import run_scenario
+    from katib_tpu.sim.scenario import load_scenario
+
+    verdict = run_scenario(
+        load_scenario(args.scenario), seed=args.seed, workdir=args.workdir
+    )
+    if args.json:
+        print(json.dumps(verdict, indent=2, sort_keys=True))
+    else:
+        print(
+            f"{verdict['verdict']}: {verdict['scenario']} "
+            f"seed={verdict['seed']} trials={verdict['trials']} "
+            f"settled={verdict['settled']} "
+            f"virtual={verdict['virtual_seconds']}s "
+            f"wall={verdict['wall_seconds']}s "
+            f"journal={verdict['journal_sha256'][:16]}"
+        )
+        for v in verdict["violations"]:
+            print(f"  violation: {v}")
+    return 0 if verdict["verdict"] == "PASS" else 1
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="katib-tpu", description="TPU-native AutoML framework CLI"
@@ -2104,6 +2132,29 @@ def main(argv: list[str] | None = None) -> int:
         help="report damage without repairing (nonzero exit if any found)",
     )
     p.set_defaults(fn=cmd_fsck)
+
+    p = sub.add_parser(
+        "sim",
+        help="virtual-time scale simulation of the orchestrator with fault "
+        "injection and invariant gates",
+    )
+    p.add_argument("scenario", help="scenario YAML path (see docs/operations.md)")
+    p.add_argument(
+        "--seed",
+        type=int,
+        default=None,
+        help="override the scenario seed (same seed => identical journal)",
+    )
+    p.add_argument(
+        "--workdir",
+        default=None,
+        help="keep sim artifacts here (default: fresh temp dir, removed "
+        "on success)",
+    )
+    p.add_argument(
+        "--json", action="store_true", help="machine-readable verdict"
+    )
+    p.set_defaults(fn=cmd_sim)
 
     p = sub.add_parser(
         "cache",

@@ -23,7 +23,9 @@ import enum
 import math
 import time
 from dataclasses import dataclass, field
-from typing import Any, Callable, Iterator, Mapping, Sequence
+
+from katib_tpu.utils.clock import get_clock
+from typing import Any, Callable, Iterable, Iterator, Mapping, Sequence
 
 __all__ = [
     "ParameterType",
@@ -805,7 +807,7 @@ class Experiment:
     condition: ExperimentCondition = ExperimentCondition.CREATED
     trials: dict[str, Trial] = field(default_factory=dict)
     optimal: OptimalTrial | None = None
-    start_time: float = field(default_factory=time.time)
+    start_time: float = field(default_factory=lambda: get_clock().time())
     completion_time: float = 0.0
     message: str = ""
     # Mutable algorithm settings (Hyperband state lives here; reference
@@ -857,11 +859,26 @@ class Experiment:
     def iter_completed(self) -> Iterator[Trial]:
         return (t for t in self.trials.values() if t.condition.is_completed_ok())
 
-    def update_optimal(self) -> None:
-        """Recompute the best trial (reference ``status_util.go`` optimal-trial agg)."""
-        best: OptimalTrial | None = None
+    def update_optimal(self, settled: Iterable[Trial] | None = None) -> None:
+        """Recompute the best trial (reference ``status_util.go`` optimal-trial agg).
+
+        ``settled`` narrows the aggregation to just-settled trials, folded
+        into the standing ``optimal`` instead of rescanning every completed
+        trial — the harvest path settles in small batches, so the full scan
+        made settlement quadratic in trial count (dominant at simulator /
+        large-sweep scale).  A completed trial's objective value is frozen
+        at settlement, so folding each exactly once is equivalent to the
+        full recompute.  With no argument the full scan runs (resume paths,
+        terminal verdicts, anything that mutated history wholesale).
+        """
         obj = self.spec.objective
-        for t in self.iter_completed():
+        if settled is None:
+            best: OptimalTrial | None = None
+            pool: Iterable[Trial] = self.iter_completed()
+        else:
+            best = self.optimal
+            pool = (t for t in settled if t.condition.is_completed_ok())
+        for t in pool:
             v = t.objective_value(obj)
             if v is None or math.isnan(v):
                 continue
@@ -880,7 +897,7 @@ class Experiment:
                 or last["objective_value"] != best.objective_value
                 or last["trial_name"] != best.trial_name
             ):
-                now = time.time()
+                now = get_clock().time()
                 # a recompute AFTER completion (e.g. resuming an old journal
                 # that predates the curve) must not charge process downtime
                 # to the curve: the run's own clock ends at completion_time

@@ -70,10 +70,10 @@ import collections
 import copy
 import statistics
 import threading
-import time
 import traceback
 
 from katib_tpu.analysis import guarded_by, make_lock
+from katib_tpu.utils.clock import get_clock
 from katib_tpu.core.types import (
     COHORT_KEY_LABEL,
     Experiment,
@@ -112,7 +112,7 @@ class OccupancyMeter:
         self._area = 0.0
 
     def update(self, busy: int) -> float:
-        now = time.monotonic()
+        now = get_clock().monotonic()
         frac = min(1.0, busy / self.slots)
         if self._t0 is None:
             if busy <= 0:
@@ -197,7 +197,7 @@ class AsyncLoops:
         self._exhausted = threading.Event()  # suggester returned exhausted
         self._suggest_inflight = False       # a get_suggestions call is running
         self._suggester_busy = False         # erroring / cooling down, not idle
-        self._last_activity = time.monotonic()
+        self._last_activity = get_clock().monotonic()
         #: terminal/drained result hand-off from the harvest thread to the
         #: supervising caller thread
         self._result: Experiment | None = None
@@ -296,7 +296,7 @@ class AsyncLoops:
             finished=done_or_halt,
         )
         try:
-            while not self._done.wait(self.orch.poll_interval):
+            while not get_clock().wait(self._done, self.orch.poll_interval):
                 sup.tick()
                 if sup.fallback:
                     return self._fallback_to_sync(sup.fallback_reason)
@@ -332,11 +332,9 @@ class AsyncLoops:
                         f"{name} loop error:\n" + traceback.format_exc(limit=20)
                     )
 
-            t = threading.Thread(
-                target=main, name=f"{name}-{self.exp.name}-g{gen}", daemon=True
+            return get_clock().spawn(
+                main, name=f"{name}-{self.exp.name}-g{gen}", daemon=True
             )
-            t.start()
-            return t
 
         return spawn
 
@@ -382,7 +380,7 @@ class AsyncLoops:
             or self.stop_event.is_set()
         ):
             return False
-        now = time.monotonic()
+        now = get_clock().monotonic()
         with self._queue_lock:
             if self._ready:
                 return True
@@ -499,17 +497,17 @@ class AsyncLoops:
             if spec.max_trial_count is not None:
                 want = min(want, spec.max_trial_count - len(exp.trials))
             if want <= 0:
-                self._halt.wait(orch.poll_interval)
+                get_clock().wait(self._halt, orch.poll_interval)
                 continue
             if not self.breaker.allow():
                 # cooling down after an error: not idle, not progress
                 self._suggester_busy = True
-                self._last_activity = time.monotonic()
-                self._halt.wait(orch.poll_interval)
+                self._last_activity = get_clock().monotonic()
+                get_clock().wait(self._halt, orch.poll_interval)
                 continue
             self._suggester_busy = False
             sug_start = orch._tracer.elapsed() if orch._tracer else 0.0
-            t0 = time.perf_counter()
+            t0 = get_clock().perf_counter()
             with self._queue_lock:  # LCK001: the scheduler bumps it in _submit
                 d0 = self._dispatched_total
             self._suggest_inflight = True
@@ -543,7 +541,7 @@ class AsyncLoops:
             # thread; write it under the same lock the counters live under
             with self._queue_lock:
                 self._consumed_last_call = self._dispatched_total - d0
-            dur = time.perf_counter() - t0
+            dur = get_clock().perf_counter() - t0
             obs.suggestion_latency.observe(dur, algorithm=spec.algorithm.name)
             obs.suggest_seconds.observe(dur, algorithm=spec.algorithm.name)
             if orch._tracer is not None and (
@@ -559,7 +557,7 @@ class AsyncLoops:
                 )
             if outcome == "error":
                 self._suggester_busy = True
-                self._last_activity = time.monotonic()
+                self._last_activity = get_clock().monotonic()
                 obs.suggester_errors.inc(algorithm=spec.algorithm.name)
             if proposals:
                 with self._state_lock:
@@ -588,14 +586,14 @@ class AsyncLoops:
                 with self._state_lock:
                     orch._persist_suggester(exp, self.suggester)
                     orch._publish(exp)
-                self._last_activity = time.monotonic()
+                self._last_activity = get_clock().monotonic()
             if outcome == "exhausted":
                 # set AFTER the final proposals are queued, so the
                 # terminal check never sees "exhausted + empty" early
                 self._exhausted.set()
                 return
             if not proposals:
-                self._halt.wait(orch.poll_interval)
+                get_clock().wait(self._halt, orch.poll_interval)
 
     # -- schedule loop -------------------------------------------------------
 
@@ -610,7 +608,7 @@ class AsyncLoops:
                 self._update_pending_gauge()
                 self._beat("schedule")
             else:
-                self._halt.wait(orch.poll_interval)
+                get_clock().wait(self._halt, orch.poll_interval)
 
     def _cohort_key_for(self, trial: Trial) -> str | None:
         if not self._use_cohorts:
@@ -638,7 +636,7 @@ class AsyncLoops:
                 else:
                     bucket = self._packing.setdefault(key, [])
                     if not bucket:
-                        self._pack_ts[key] = time.monotonic()
+                        self._pack_ts[key] = get_clock().monotonic()
                     bucket.append(trial)
                     if len(bucket) & (len(bucket) - 1) == 0:
                         # speculative prewarm at each power-of-two fill
@@ -661,7 +659,7 @@ class AsyncLoops:
         smaller than the cohort width waiting forever."""
         spec = self.spec
         flushed = 0
-        now = time.monotonic()
+        now = get_clock().monotonic()
         budget_left = (
             spec.max_trial_count - len(self.exp.trials)
             if spec.max_trial_count is not None
@@ -761,22 +759,22 @@ class AsyncLoops:
     def _submit(self, unit: list[Trial]) -> None:  # lint: holds(_queue_lock)
         orch, exp = self.orch, self.exp
         orch._submit_prewarm(self.spec, unit, self.mesh)
-        now = time.time()
+        now = get_clock().time()
         for t in unit:
             t.condition = TrialCondition.RUNNING
             t.start_time = now
         orch._jappend_group("started", exp, unit)
         if len(unit) == 1:
-            fut = self.pool.submit(orch._execute, exp, unit[0], self.mesh)
+            fut = get_clock().submit(self.pool, orch._execute, exp, unit[0], self.mesh)
             owner: Trial | list[Trial] = unit[0]
         else:
-            fut = self.pool.submit(orch._execute_cohort, exp, unit, self.mesh)
+            fut = get_clock().submit(self.pool, orch._execute_cohort, exp, unit, self.mesh)
             owner = unit
         with self._futures_lock:
             self.futures[fut] = owner
-            self._fut_meta[fut] = time.monotonic()
+            self._fut_meta[fut] = get_clock().monotonic()
         self._dispatched_total += len(unit)
-        self._last_activity = time.monotonic()
+        self._last_activity = get_clock().monotonic()
         # the harvest loop republishes status.json soon after: without
         # this, a run whose trials all dispatch between publishes would
         # never show a Running trial to external watchers
@@ -870,7 +868,7 @@ class AsyncLoops:
                 and not self._suggester_busy
                 and not self._suggest_inflight
             ):
-                if time.monotonic() - self._last_activity > _STALL_SECONDS:
+                if get_clock().monotonic() - self._last_activity > _STALL_SECONDS:
                     return self._finalize(
                         lambda: self._terminal(
                             ExperimentCondition.FAILED,
@@ -881,9 +879,9 @@ class AsyncLoops:
                         )
                     )
             else:
-                self._last_activity = max(self._last_activity, time.monotonic() - 1.0)
+                self._last_activity = max(self._last_activity, get_clock().monotonic() - 1.0)
             self._beat("harvest")
-            time.sleep(orch.poll_interval)
+            get_clock().sleep(orch.poll_interval)
         return None
 
     # -- speculative straggler re-dispatch -----------------------------------
@@ -891,7 +889,7 @@ class AsyncLoops:
     def _note_settled_futures(self) -> None:
         """Record settle durations (dispatch -> harvested) for the straggler
         median; a future gone from the shared dict was settled/cancelled."""
-        now = time.monotonic()
+        now = get_clock().monotonic()
         with self._futures_lock:
             gone = [f for f in self._fut_meta if f not in self.futures]
             for f in gone:
@@ -910,7 +908,7 @@ class AsyncLoops:
         if len(durations) < 3:
             return
         threshold = self.spec.straggler_factor * statistics.median(durations)
-        now = time.monotonic()
+        now = get_clock().monotonic()
         candidates: list[tuple[object, Trial]] = []
         with self._futures_lock:
             free = self.member_limit - self._undone_members() - len(
@@ -941,14 +939,14 @@ class AsyncLoops:
             clone.checkpoint_dir = clone.checkpoint_dir + "-speculative"
         clone.condition = TrialCondition.RUNNING
         clone.message = ""
-        fut = self.pool.submit(self.orch._execute, self.exp, clone, self.mesh)
+        fut = get_clock().submit(self.pool, self.orch._execute, self.exp, clone, self.mesh)
         with self._futures_lock:
             # LCK001 fix: _maybe_speculate filters candidates against
             # _speculated under this lock; the add used to race it bare
             self._speculated.add(trial.name)
             self._rivals[fut] = (orig_fut, trial.name, clone)
         obs.speculative_dispatches.inc()
-        self._last_activity = time.monotonic()
+        self._last_activity = get_clock().monotonic()
 
     def _check_speculations(self) -> None:
         """First-settle-wins arbitration.  A rival that finishes with a
@@ -984,7 +982,7 @@ class AsyncLoops:
                 self.futures.pop(orig_fut, None)
                 self._fut_meta.pop(orig_fut, None)
                 self.futures[f] = clone
-                self._fut_meta.setdefault(f, time.monotonic())
+                self._fut_meta.setdefault(f, get_clock().monotonic())
                 self.exp.trials[name] = clone
             self._spec_wins += 1
             obs.speculative_wins.inc()
@@ -1052,7 +1050,7 @@ class AsyncLoops:
         threads = sup.threads() if sup is not None else []
         for t in threads:
             if t is not threading.current_thread():
-                t.join(timeout=_JOIN_TIMEOUT)
+                get_clock().join_thread(t, timeout=_JOIN_TIMEOUT)
 
     def _terminal(
         self, verdict: ExperimentCondition, message: str | None = None
@@ -1067,7 +1065,7 @@ class AsyncLoops:
             orch._harvest(exp, self.futures, wait_running=True)
         # proposed-but-undispatched trials mirror the sync loop's
         # cancelled-future semantics: settled KILLED, budget consumed
-        now = time.time()
+        now = get_clock().time()
         for t in self._drain_queues():
             t.condition = TrialCondition.KILLED
             t.message = "cancelled: experiment terminal before dispatch"
@@ -1079,7 +1077,7 @@ class AsyncLoops:
             orch._observe_trial_duration(t)
         exp.condition = verdict
         exp.message = message if message is not None else orch._terminal_message(verdict)
-        exp.completion_time = time.time()
+        exp.completion_time = get_clock().time()
         exp.update_optimal()
         self._record_stats()
         orch._finish(exp)

@@ -28,7 +28,6 @@ import os
 import secrets
 import shutil
 import threading
-import time
 import traceback
 
 from katib_tpu.core.types import (
@@ -54,6 +53,7 @@ from katib_tpu.store.base import MemoryObservationStore, ObservationStore
 from katib_tpu.suggest.base import call_suggester, make_suggester
 from katib_tpu.utils import faults
 from katib_tpu.utils import observability as obs
+from katib_tpu.utils.clock import get_clock
 from katib_tpu.utils import tracing
 from katib_tpu.utils.watchdog import Watchdog
 
@@ -74,6 +74,12 @@ class Orchestrator:
         slice_allocator=None,
         fault_injector: faults.FaultInjector | None = None,
         preflight: bool | None = None,
+        run_trial_fn=None,
+        run_cohort_fn=None,
+        token_hex=None,
+        journal_snapshot_every: int | None = None,
+        status_publish_interval: float = 0.0,
+        suggester_fn=None,
     ):
         self.store = store if store is not None else MemoryObservationStore()
         # a defaulted store may be upgraded to the durable sqlite backend at
@@ -145,6 +151,25 @@ class Orchestrator:
         #: run (orchestrator/async_loops.py); None under the sync path —
         #: bench.py and the CI async smoke read it after run() returns
         self.async_stats: dict | None = None
+        # Dispatch seams: the virtual-time simulator (katib_tpu/sim) swaps
+        # ONLY these — a modeled executor with seeded durations replaces the
+        # real runner while every scheduling/settlement path stays real.
+        self._run_trial_fn = run_trial_fn
+        self._run_cohort_fn = run_cohort_fn
+        # Trial-name entropy seam: secrets.token_hex in production, a seeded
+        # stream under the simulator so journals are byte-reproducible.
+        self._token_hex = token_hex if token_hex is not None else secrets.token_hex
+        # Journal compaction cadence override (None = journal default).  At
+        # 50k simulated trials the default every-32-settlements snapshot is
+        # O(N^2/32) serialization work.
+        self._journal_snapshot_every = journal_snapshot_every
+        # Suggester construction seam (None = make_suggester): the simulator
+        # wraps the real suggester with a modeled latency distribution.
+        self._suggester_fn = suggester_fn
+        # status.json republish throttle in clock seconds (0 = every call).
+        # Each write serializes EVERY trial; at scale that dominates.
+        self._status_publish_interval = float(status_publish_interval)
+        self._status_published_at: float | None = None
 
     def stop(self) -> None:
         """Request the experiment wind down (the reference's experiment
@@ -212,7 +237,7 @@ class Orchestrator:
                 exp.condition = ExperimentCondition.RESTARTING
                 exp.completion_time = 0.0
 
-        suggester = make_suggester(spec)
+        suggester = (self._suggester_fn or make_suggester)(spec)
         # restore durable suggester state (ENAS controller pytree, PBT job
         # queue) — the FromVolume PVC analog, FENCED against the experiment
         # journal: a pickle written before settlements the journal proves
@@ -254,7 +279,13 @@ class Orchestrator:
         try:
             from katib_tpu.orchestrator.journal import ExperimentJournal
 
-            self._journal = ExperimentJournal(self.workdir, exp.name)
+            if self._journal_snapshot_every is not None:
+                self._journal = ExperimentJournal(
+                    self.workdir, exp.name,
+                    snapshot_every=self._journal_snapshot_every,
+                )
+            else:
+                self._journal = ExperimentJournal(self.workdir, exp.name)
         except OSError:
             self._journal = None
         if experiment is not None:
@@ -273,10 +304,16 @@ class Orchestrator:
         obs.experiments_current.inc()
         # open the span journal (append-mode: a resumed experiment continues
         # from the prior max elapsed offset); tracing is best-effort — an
-        # unwritable workdir must not fail the experiment
+        # unwritable workdir must not fail the experiment, and KATIB_TRACE=0
+        # suppresses it entirely
         try:
-            self._tracer = tracing.Tracer(
-                tracing.trace_path(self.workdir, exp.name), experiment=exp.name
+            self._tracer = (
+                tracing.Tracer(
+                    tracing.trace_path(self.workdir, exp.name),
+                    experiment=exp.name,
+                )
+                if tracing.enabled()
+                else None
             )
         except OSError:
             self._tracer = None
@@ -330,7 +367,7 @@ class Orchestrator:
         except Exception:
             exp.condition = ExperimentCondition.FAILED
             exp.message = "mesh config error:\n" + traceback.format_exc(limit=5)
-            exp.completion_time = time.time()
+            exp.completion_time = get_clock().time()
             exp.update_optimal()
             self._finish(exp)
             raise
@@ -346,7 +383,7 @@ class Orchestrator:
             if not report.ok():
                 exp.condition = ExperimentCondition.FAILED
                 exp.message = "device preflight failed: " + report.summary()
-                exp.completion_time = time.time()
+                exp.completion_time = get_clock().time()
                 exp.update_optimal()
                 self._finish(exp)
                 raise RuntimeError(exp.message)
@@ -384,9 +421,9 @@ class Orchestrator:
                         orphans.append(trial)
                         continue
                     trial.condition = TrialCondition.RUNNING
-                    trial.start_time = time.time()
+                    trial.start_time = get_clock().time()
                     self._jappend("started", exp, trial=trial)
-                    futures[pool.submit(self._execute, exp, trial, mesh)] = trial
+                    futures[get_clock().submit(pool, self._execute, exp, trial, mesh)] = trial
             if use_async:
                 from katib_tpu.orchestrator.async_loops import AsyncLoops
 
@@ -426,8 +463,8 @@ class Orchestrator:
                 ]
                 for trial in resubmit:
                     trial.condition = TrialCondition.RUNNING
-                    trial.start_time = time.time()
-                    futures[pool.submit(self._execute, exp, trial, mesh)] = trial
+                    trial.start_time = get_clock().time()
+                    futures[get_clock().submit(pool, self._execute, exp, trial, mesh)] = trial
                 self._jappend_group("started", exp, resubmit)
             while True:
                 self._harvest(exp, futures)
@@ -440,7 +477,7 @@ class Orchestrator:
                     self._harvest(exp, futures, wait_running=True)
                     exp.condition = ExperimentCondition.FAILED
                     exp.message = "experiment stopped"
-                    exp.completion_time = time.time()
+                    exp.completion_time = get_clock().time()
                     exp.update_optimal()
                     self._finish(exp)
                     return exp
@@ -457,7 +494,7 @@ class Orchestrator:
                     self._cancel_pending(futures)
                     self._harvest(exp, futures, wait_running=True)
                     exp.condition = verdict
-                    exp.completion_time = time.time()
+                    exp.completion_time = get_clock().time()
                     exp.update_optimal()
                     exp.message = self._terminal_message(verdict)
                     self._finish(exp)
@@ -473,7 +510,7 @@ class Orchestrator:
                         suggester_busy = True
                     else:
                         sug_start = self._tracer.elapsed() if self._tracer else 0.0
-                        t_sug = time.perf_counter()
+                        t_sug = get_clock().perf_counter()
                         proposals, outcome = call_suggester(
                             suggester, exp, want, breaker, self.fault_injector
                         )
@@ -482,7 +519,7 @@ class Orchestrator:
                         elif outcome == "error":
                             suggester_busy = True
                             obs.suggester_errors.inc(algorithm=spec.algorithm.name)
-                        sug_dur = time.perf_counter() - t_sug
+                        sug_dur = get_clock().perf_counter() - t_sug
                         obs.suggestion_latency.observe(
                             sug_dur, algorithm=spec.algorithm.name
                         )
@@ -513,13 +550,13 @@ class Orchestrator:
                             self._submit_prewarm(spec, trials, mesh)
                             if len(trials) == 1:
                                 futures[
-                                    pool.submit(self._execute, exp, trials[0], mesh)
+                                    get_clock().submit(pool, self._execute, exp, trials[0], mesh)
                                 ] = trials[0]
                             else:
                                 # one pool slot runs the whole cohort; the
                                 # member list keeps _shortfall's budget honest
                                 futures[
-                                    pool.submit(self._execute_cohort, exp, trials, mesh)
+                                    get_clock().submit(pool, self._execute_cohort, exp, trials, mesh)
                                 ] = trials
                         if proposals:
                             self._persist_suggester(exp, suggester)
@@ -539,7 +576,7 @@ class Orchestrator:
                         f"(suggester_max_errors={spec.suggester_max_errors}); "
                         "last error:\n" + breaker.last_failure
                     )
-                    exp.completion_time = time.time()
+                    exp.completion_time = get_clock().time()
                     exp.update_optimal()
                     self._finish(exp)
                     return exp
@@ -556,13 +593,13 @@ class Orchestrator:
                             "orchestrator stalled: suggester proposes nothing "
                             "with no trials in flight"
                         )
-                        exp.completion_time = time.time()
+                        exp.completion_time = get_clock().time()
                         exp.update_optimal()
                         self._finish(exp)
                         return exp
                 else:
                     stalled_polls = 0
-                time.sleep(self.poll_interval)
+                get_clock().sleep(self.poll_interval)
           except Exception:
             # bookkeeping must not be skipped on an orchestrator/suggester
             # bug: wind down in-flight trials, record the failure, balance
@@ -572,7 +609,7 @@ class Orchestrator:
             self._harvest(exp, futures, wait_running=True)
             exp.condition = ExperimentCondition.FAILED
             exp.message = "orchestrator error:\n" + traceback.format_exc(limit=20)
-            exp.completion_time = time.time()
+            exp.completion_time = get_clock().time()
             exp.update_optimal()
             self._finish(exp)
             raise
@@ -694,7 +731,7 @@ class Orchestrator:
         condition: TrialCondition = TrialCondition.RUNNING,
         journal: bool = True,
     ) -> Trial:
-        name = proposal.name or f"{exp.name}-{secrets.token_hex(4)}"
+        name = proposal.name or f"{exp.name}-{self._token_hex(4)}"
         rules = list(proposal.early_stopping_rules)
         if early_stopper is not None and not rules:
             rules = early_stopper.get_rules(exp)
@@ -725,7 +762,7 @@ class Orchestrator:
             # async proposals wait in the ready queue as PENDING (started at
             # dispatch); the sync loop submits immediately as RUNNING
             condition=condition,
-            start_time=time.time() if condition is TrialCondition.RUNNING else 0.0,
+            start_time=get_clock().time() if condition is TrialCondition.RUNNING else 0.0,
             checkpoint_dir=ckpt,
         )
         exp.trials[name] = trial
@@ -881,7 +918,7 @@ class Orchestrator:
         serial path (same name + checkpoint dir, full remaining budget)."""
         with tracing.use_tracer(self._tracer):
             try:
-                results = run_cohort(
+                results = (self._run_cohort_fn or run_cohort)(
                     trials,
                     self.store,
                     exp.spec.objective,
@@ -1072,7 +1109,7 @@ class Orchestrator:
                 with costprofiler.capture(
                     trace_dir, trial=trial.name, experiment=exp.name
                 ):
-                    return run_trial(
+                    return (self._run_trial_fn or run_trial)(
                         trial, self.store, exp.spec.objective,
                         mesh=mesh, stop_event=self._stop_event,
                         injector=self.fault_injector,
@@ -1087,7 +1124,7 @@ class Orchestrator:
                 )
             finally:
                 self._profile_lock.release()
-        return run_trial(
+        return (self._run_trial_fn or run_trial)(
             trial,
             self.store,
             exp.spec.objective,
@@ -1106,7 +1143,7 @@ class Orchestrator:
             obs.experiments_failed.inc(algorithm=exp.spec.algorithm.name)
         else:
             obs.experiments_succeeded.inc(algorithm=exp.spec.algorithm.name)
-        duration = (exp.completion_time or time.time()) - exp.start_time
+        duration = (exp.completion_time or get_clock().time()) - exp.start_time
         obs.experiment_duration.observe(
             max(duration, 0.0),
             algorithm=exp.spec.algorithm.name,
@@ -1134,7 +1171,7 @@ class Orchestrator:
                 self._journal.snapshot(experiment_to_dict(exp))
             except (OSError, ValueError):
                 pass
-        self._publish(exp)
+        self._publish(exp, force=True)
 
     def _drain_and_exit(
         self,
@@ -1161,11 +1198,11 @@ class Orchestrator:
         grace = max(0.0, spec.drain_grace_seconds)
         obs.drain_requested.set(1.0)
         drain_start = self._tracer.elapsed() if self._tracer else 0.0
-        t0 = time.perf_counter()
+        t0 = get_clock().perf_counter()
         self._cancel_pending(futures)
         drain_event.set()
         if futures:
-            cf.wait(list(futures), timeout=grace)
+            get_clock().wait_futures(futures, timeout=grace)
         self._harvest(exp, futures, drain=True)
         checkpointed = sum(
             1 for t in exp.trials.values() if t.condition is TrialCondition.DRAINED
@@ -1195,7 +1232,7 @@ class Orchestrator:
         )
         self.drained = True
         self._jappend("experiment", exp)
-        duration = time.perf_counter() - t0
+        duration = get_clock().perf_counter() - t0
         obs.experiments_current.dec()
         tracer, self._tracer = self._tracer, None
         if tracer is not None:
@@ -1280,9 +1317,17 @@ class Orchestrator:
             # the experiment result from run()'s finally block
             pass
 
-    def _publish(self, exp: Experiment) -> None:
+    def _publish(self, exp: Experiment, force: bool = False) -> None:
         """Journal status for CLI/UI views (``status.json`` per experiment);
-        never lets a status-write failure kill the run loop."""
+        never lets a status-write failure kill the run loop.  Throttled by
+        ``status_publish_interval`` (clock seconds) unless ``force``d —
+        terminal states always publish."""
+        if not force and self._status_publish_interval > 0.0:
+            now = get_clock().monotonic()
+            last = self._status_published_at
+            if last is not None and now - last < self._status_publish_interval:
+                return
+            self._status_published_at = now
         try:
             from katib_tpu.orchestrator.status import write_status
 
@@ -1299,7 +1344,7 @@ class Orchestrator:
     ) -> None:
         done = [f for f in futures if f.done()]
         if wait_running and futures:
-            done = list(cf.wait(list(futures)).done)
+            done = list(get_clock().wait_futures(futures).done)
         for f in done:
             # A future owns either one trial (serial) or a list (cohort);
             # cohort futures resolve to a {name: TrialResult} dict.
@@ -1315,7 +1360,7 @@ class Orchestrator:
                         self._jappend("drained", exp, trial=trial)
                         continue
                     trial.condition = TrialCondition.KILLED
-                    trial.completion_time = time.time()
+                    trial.completion_time = get_clock().time()
                     obs.trials_killed.inc()
                     self._jappend("settled", exp, trial=trial)
                     self._observe_trial_duration(trial)
@@ -1364,7 +1409,7 @@ class Orchestrator:
                         # retry (journal answers "what did this trial survive?");
                         # clean first-attempt results clear any resumed leftover
                         trial.failure_kind = None
-                    trial.completion_time = time.time()
+                    trial.completion_time = get_clock().time()
                     if trial.condition in (
                         TrialCondition.SUCCEEDED,
                         TrialCondition.EARLY_STOPPED,
@@ -1388,37 +1433,63 @@ class Orchestrator:
                     trial.message = f"settle failed: {exc!r}"
                     trial.failure_kind = kind.value
                     if not trial.completion_time:
-                        trial.completion_time = time.time()
+                        trial.completion_time = get_clock().time()
                     obs.trials_failed.inc()
                 settled.append(trial)
             members = settled
-            exp.update_optimal()
+            # incremental: fold only this settle batch into the optimal —
+            # the full recompute per batch is quadratic at sweep scale
+            exp.update_optimal(members)
             # durably journal each member's outcome: terminal conditions are
             # exactly-once settlements keyed by (trial, attempt epoch);
             # Drained stays non-terminal (resubmitted on resume).  The
             # "reported" record carries the reduced observation separately
             # so replay can restore metrics for trials the settle record of
-            # which is ever lost to a torn tail.
-            for trial in members:
-                if trial.condition is TrialCondition.DRAINED:
-                    self._jappend("drained", exp, trial=trial)
-                else:
-                    if trial.observation is not None:
-                        from katib_tpu.orchestrator.status import (
-                            _observation_to_dict,
-                        )
+            # which is ever lost to a torn tail.  The whole batch goes
+            # through one append_group — record content and order are
+            # identical to per-trial appends, but the batch pays a single
+            # durability barrier instead of two per member.
+            if self._journal is not None:
+                try:
+                    from katib_tpu.orchestrator.status import (
+                        _observation_to_dict,
+                        trial_to_dict,
+                    )
 
-                        self._jappend(
-                            "reported",
-                            exp,
-                            trial=trial,
-                            extra={
-                                "observation": _observation_to_dict(
-                                    trial.observation
-                                )
-                            },
-                        )
-                    self._jappend("settled", exp, trial=trial)
+                    exp_state = self._journal_exp_state(exp)
+                    records = []
+                    for trial in members:
+                        tdict = trial_to_dict(trial)
+                        if trial.condition is TrialCondition.DRAINED:
+                            records.append((
+                                "drained",
+                                trial.name,
+                                trial.retry_count,
+                                {"exp": exp_state, "trial": tdict},
+                            ))
+                            continue
+                        if trial.observation is not None:
+                            records.append((
+                                "reported",
+                                trial.name,
+                                trial.retry_count,
+                                {
+                                    "exp": exp_state,
+                                    "trial": tdict,
+                                    "observation": _observation_to_dict(
+                                        trial.observation
+                                    ),
+                                },
+                            ))
+                        records.append((
+                            "settled",
+                            trial.name,
+                            trial.retry_count,
+                            {"exp": exp_state, "trial": tdict},
+                        ))
+                    self._journal.append_group(records)
+                except (OSError, ValueError):
+                    pass
         if done:
             if self._journal is not None:
                 try:
@@ -1441,6 +1512,8 @@ class Orchestrator:
             or trial.checkpoint_dir is None
             or trial.name in self._suggester_owned_ckpts
             or trial.condition is not TrialCondition.SUCCEEDED
+            # nothing was ever checkpointed — skip the per-step scan
+            or not os.path.isdir(trial.checkpoint_dir)
         ):
             return
         from katib_tpu.utils.checkpoint import (
@@ -1492,13 +1565,20 @@ class Orchestrator:
             and exp.failed_count >= spec.max_failed_trial_count
         ):
             return ExperimentCondition.FAILED
-        exp.update_optimal()
+        # exp.optimal is maintained incrementally by _harvest per settle
+        # batch (trials terminal-ize nowhere else while the loops run); a
+        # full update_optimal() here ran once per poll — quadratic at
+        # sweep scale
         if exp.optimal is not None and spec.objective.is_goal_reached(
             exp.optimal.objective_value
         ):
             return ExperimentCondition.GOAL_REACHED
         if (
             spec.max_trial_count is not None
+            # terminal trials <= all trials: the O(1) guard keeps the O(n)
+            # budget scan off the poll loop until the budget can actually
+            # be reached (the final lookahead window)
+            and len(exp.trials) >= spec.max_trial_count
             and self._budget_used(exp) >= spec.max_trial_count
         ):
             return ExperimentCondition.MAX_TRIALS_REACHED

@@ -31,7 +31,8 @@ from __future__ import annotations
 
 import os
 import random
-import time
+
+from katib_tpu.utils.clock import get_clock
 
 #: post-fault sustained occupancy must recover to this fraction of the
 #: pre-fault baseline (acceptance bar from the supervision issue)
@@ -65,7 +66,7 @@ def _soak_trainer(ctx):
     for step in range(start, 3):
         with open(marker, "w") as f:
             f.write(str(step + 1))
-        time.sleep(sleep)
+        get_clock().sleep(sleep)
         if not ctx.report(
             step=step, accuracy=(1.0 - 0.2 * (x - 0.05) ** 2) * (step + 1) / 3
         ):
@@ -205,8 +206,13 @@ def run_soak(
 
     from katib_tpu.utils.faults import FaultInjector
 
+    # the shared determinism seam: fault schedule and durations flow
+    # through the ambient clock (real for a wall soak; a VirtualClock when
+    # driven from the simulator) and an explicit seeded rng — the same
+    # (clock, rng) injection the sim's ModeledExecutor uses
+    clock = get_clock()
     rng = random.Random(seed)
-    start = time.monotonic()
+    start = clock.monotonic()
     deadline = start + float(seconds)
     failures: list[str] = []
     occupancy: dict[str, float] = {}
@@ -281,7 +287,9 @@ def run_soak(
         return r
 
     def run_one(rnd, round_seed):
-        injector = FaultInjector(seed=round_seed)
+        injector = FaultInjector(
+            seed=round_seed, rng=random.Random(round_seed), clock=clock
+        )
         if rnd.arm is not None:
             rnd.arm(injector)
         spec = _make_spec(
@@ -298,9 +306,9 @@ def run_soak(
         try:
             with tempfile.TemporaryDirectory(prefix="katib-soak-") as workdir:
                 orch = Orchestrator(workdir=workdir, fault_injector=injector)
-                t0 = time.monotonic()
+                t0 = clock.monotonic()
                 exp = orch.run(spec)
-                dt = time.monotonic() - t0
+                dt = clock.monotonic() - t0
                 errs = _check_round(rnd, exp, orch, workdir, spec, injector)
         finally:
             os.environ.pop(_SLOW_ENV, None)
@@ -330,7 +338,7 @@ def run_soak(
     for i, rnd in enumerate(core):
         run_one(rnd, seed * 1000 + i)
     i = len(core)
-    while time.monotonic() < deadline - 10.0 and i < 50:
+    while clock.monotonic() < deadline - 10.0 and i < 50:
         run_one(mixed_round(i), seed * 1000 + i)
         i += 1
     run_one(post, seed * 1000 + i)
@@ -364,7 +372,7 @@ def run_soak(
                 "of the documented acquire order: "
                 + "; ".join(" -> ".join(c) for c in cycles[:3])
             )
-    elapsed = time.monotonic() - start
+    elapsed = clock.monotonic() - start
     if failures:
         print(
             f"SOAK FAIL ({elapsed:.0f}s, {i + 2} rounds): "

@@ -50,11 +50,11 @@ diagnosed exit rather than a silent hang.
 from __future__ import annotations
 
 import threading
-import time
 from typing import Callable
 
 from katib_tpu.analysis import guarded_by, make_lock
 from katib_tpu.utils import observability as obs
+from katib_tpu.utils.clock import get_clock
 from katib_tpu.utils.faults import Backoff
 from katib_tpu.utils.watchdog import Watchdog
 
@@ -109,7 +109,7 @@ class LoopSupervisor:
         stall_deadline: float = 60.0,
         restart_budget: int = 3,
         backoff: Backoff | None = None,
-        clock=time.monotonic,
+        clock=None,
         on_restart: Callable[[str, int, str, int], None] | None = None,
         on_fallback: Callable[[str], None] | None = None,
     ):
@@ -120,11 +120,13 @@ class LoopSupervisor:
         self.backoff = backoff or Backoff(
             base=0.5, factor=2.0, cap=10.0, full_jitter=True, seed=0
         )
-        self._clock = clock
+        # None = the ambient injectable clock (utils.clock); tests still
+        # inject bare callables for deterministic classification.
+        self._clock = clock if clock is not None else (lambda: get_clock().monotonic())
         self.on_restart = on_restart
         self.on_fallback = on_fallback
         # registry only — no monitor thread; tick() is the scan
-        self._wd = Watchdog(clock=clock, start=False)
+        self._wd = Watchdog(clock=self._clock, start=False)
         self._loops: dict[str, _Loop] = {}
         self._gen_lock = make_lock("supervisor.gen")
         self._fallback_reason: str | None = None

@@ -28,9 +28,9 @@ import re
 import signal
 import subprocess
 import threading
-import time
 import traceback
 
+from katib_tpu.utils.clock import get_clock
 from katib_tpu.core.types import (
     MetricsCollectorKind,
     Trial,
@@ -256,13 +256,13 @@ def _run_whitebox(
         ARTIFACTS.fetch_family(first_step_sig)
     except Exception:
         pass
-    started_holder = [time.perf_counter()]
+    started_holder = [get_clock().perf_counter()]
     first_step_seen = [False]
     last_beat = [0.0]
     cost_attrs: dict = {}
 
     def _beat() -> None:
-        now = time.perf_counter()
+        now = get_clock().perf_counter()
         if not first_step_seen[0]:
             first_step_seen[0] = True
             try:
@@ -366,7 +366,7 @@ def _run_whitebox(
         # executor threads are reused: a previous trial's observed cost
         # must not leak into this trial's heartbeat publications
         costmodel.clear_active()
-        started_holder[0] = time.perf_counter()  # first-step clock starts here
+        started_holder[0] = get_clock().perf_counter()  # first-step clock starts here
         last_beat[0] = started_holder[0]
         with tracing.span("train_fn", trial=trial.name) as sp:
             trial.spec.train_fn(ctx)
@@ -493,8 +493,9 @@ class _StdoutSource(_LineSource):
                 self._log = open(log_path, "w", buffering=1, errors="replace")
             except OSError:
                 self._log = None  # log capture is best-effort
-        self._thread = threading.Thread(target=self._drain, args=(proc,), daemon=True)
-        self._thread.start()
+        self._thread = get_clock().spawn(
+            lambda: self._drain(proc), name="katib-stdout-drain", daemon=True
+        )
 
     def _drain(self, proc: subprocess.Popen) -> None:
         assert proc.stdout is not None
@@ -573,7 +574,7 @@ class _PrometheusScraper:
     def poll(self):
         from katib_tpu.runner.metrics import parse_prometheus_samples
 
-        now = time.monotonic()
+        now = get_clock().monotonic()
         if now < self._next_scrape:
             return []
         self._next_scrape = now + self.interval
@@ -675,7 +676,7 @@ def _run_blackbox(
             f"failed to launch {argv[0]}: {e}",
             failure_kind=classify_exception(e),
         )
-    launched_at = time.perf_counter()
+    launched_at = get_clock().perf_counter()
 
     # metrics come from exactly one source: the file when configured, else
     # stdout (no double-reporting); stdout is always drained to avoid blocking
@@ -695,7 +696,7 @@ def _run_blackbox(
     hanged = False
     drained = False
     deadline = (
-        time.monotonic() + trial.spec.max_runtime_seconds
+        get_clock().monotonic() + trial.spec.max_runtime_seconds
         if trial.spec.max_runtime_seconds is not None
         else None
     )
@@ -742,7 +743,7 @@ def _run_blackbox(
                 # ask the trainer to exit (its own SIGTERM handler may
                 # checkpoint); the escalation below bounds a deaf one
                 drained = True
-            if deadline is not None and time.monotonic() > deadline:
+            if deadline is not None and get_clock().monotonic() > deadline:
                 # per-trial wall-clock bound: SIGTERM (then SIGKILL below) the
                 # hung trial instead of pinning an orchestrator slot forever
                 deadline_hit = True
@@ -750,20 +751,20 @@ def _run_blackbox(
                 early_stopped or killed or deadline_hit or hanged or drained
             ) and terminate_at is None:
                 _signal_group(proc, signal.SIGTERM)
-                terminate_at = time.monotonic()
-            if terminate_at is not None and time.monotonic() - terminate_at > 10.0:
+                terminate_at = get_clock().monotonic()
+            if terminate_at is not None and get_clock().monotonic() - terminate_at > 10.0:
                 # SIGTERM ignored; escalate (classification unchanged)
                 _signal_group(proc, signal.SIGKILL)
                 terminate_at = float("inf")
             if proc.poll() is not None:
                 break
-            time.sleep(0.05)
+            get_clock().sleep(0.05)
     finally:
         if heartbeat is not None:
             heartbeat.close()
     rc = proc.wait()
     tracing.record_span(
-        "subprocess", time.perf_counter() - launched_at, trial=trial.name, rc=rc
+        "subprocess", get_clock().perf_counter() - launched_at, trial=trial.name, rc=rc
     )
 
     # final sweep for lines written right before exit (including a last line

@@ -1,0 +1,16 @@
+"""Virtual-time scale simulator: a discrete-event twin of the orchestrator.
+
+The package substitutes exactly two things in a real experiment run: the
+ambient clock (``katib_tpu.utils.clock``) becomes a :class:`VirtualClock`
+that advances to the next armed timer instead of sleeping, and the trial
+dispatch seam (``Orchestrator(run_trial_fn=...)``) becomes a modeled
+executor whose durations are drawn (seeded) from committed bench
+distributions.  Everything else — orchestrator, async loops, supervisor,
+journal, suggester, fault injector — is the real production code.
+"""
+
+from katib_tpu.sim.clock import VirtualClock
+from katib_tpu.sim.scenario import Scenario, load_scenario
+from katib_tpu.sim.runner import run_scenario
+
+__all__ = ["VirtualClock", "Scenario", "load_scenario", "run_scenario"]

@@ -1,0 +1,360 @@
+"""Deterministic virtual clock: a run-token scheduler over real threads.
+
+The orchestrator stack is genuinely multi-threaded (suggest / schedule /
+harvest loops, a trial pool, a watchdog, a supervisor).  Rather than
+reimplement it as coroutines — which would stop exercising the real code —
+the simulator keeps the real threads and serializes them: at most ONE
+managed thread runs at any moment (it holds the *run token*); every other
+managed thread is parked inside a clock call.  Parking registers a waiter
+``(seq, predicate, deadline)``; when the token is released the dispatcher
+grants the lowest-seq waiter whose predicate holds, and when nothing is
+runnable it advances virtual time to the earliest armed deadline.  Because
+every scheduling decision happens at a clock call under one lock, with
+ticket numbers assigned only by the token holder, the interleaving — and
+therefore the journal — is a pure function of the seed.
+
+Three mechanisms close the classic determinism holes:
+
+* **Arrival handshake** — ``spawn``/``submit`` assign the new thread's
+  ticket while the caller still holds the token, then block the caller (in
+  real time) until the new thread has parked.  A set of threads "starting
+  concurrently" therefore joins the waiter list in ticket order, never in
+  OS scheduling order.
+* **Depart barrier** — a pool task's wrapper releases the token *before*
+  ``ThreadPoolExecutor`` resolves its Future, so the dispatcher holds all
+  grants until the Future's done-callback clears the barrier.  The next
+  token holder consequently sees ``f.done()`` deterministically.
+* **Virtual liveness** — threads created through ``spawn`` report
+  ``is_alive()`` from a flag flipped in the wrapper's ``finally``, not from
+  OS thread state, so the supervisor's crashed/stalled classification is a
+  function of virtual time only.
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _real_time
+from collections import namedtuple
+from typing import Any, Callable, Iterable
+
+import concurrent.futures as cf
+
+from katib_tpu.analysis import make_lock
+
+# Virtual wall-clock epoch: journal `ts` fields become epoch + virtual
+# offset, so same-seed runs produce byte-identical journals regardless of
+# when they execute.
+VIRTUAL_EPOCH = 1_700_000_000.0
+
+# If no waiter has been granted for this much REAL time the simulation is
+# wedged outside the clock (a real deadlock, not a virtual one) — every
+# parked thread raises rather than hanging CI.
+_WALL_STALL_SECONDS = 60.0
+_HANDSHAKE_SECONDS = 60.0
+
+DoneAndNotDoneFutures = namedtuple("DoneAndNotDoneFutures", ["done", "not_done"])
+
+
+class VirtualDeadlock(RuntimeError):
+    """All managed threads parked, no predicate true, no deadline armed."""
+
+
+class _Waiter:
+    __slots__ = ("seq", "predicate", "deadline", "event", "woke", "granted", "name")
+
+    def __init__(self, seq, predicate, deadline, name):
+        self.seq = seq
+        self.predicate = predicate
+        self.deadline = deadline
+        self.event = threading.Event()
+        self.woke = False
+        self.granted = False
+        self.name = name
+
+
+class _VThread(threading.Thread):
+    """Thread whose liveness is a virtual-time fact, not an OS fact."""
+
+    def __init__(self, *args: Any, **kwargs: Any) -> None:
+        super().__init__(*args, **kwargs)
+        self._v_started = False
+        self._v_departed = False
+
+    def start(self) -> None:
+        self._v_started = True
+        super().start()
+
+    def is_alive(self) -> bool:
+        return self._v_started and not self._v_departed
+
+
+class VirtualClock:
+    """Drop-in for the ambient clock that makes time a simulation variable."""
+
+    def __init__(
+        self,
+        *,
+        epoch: float = VIRTUAL_EPOCH,
+        max_virtual_seconds: float | None = None,
+    ) -> None:
+        self._lock = make_lock("sim.clock")
+        self._now = 0.0
+        self._epoch = epoch
+        self._seq = 0
+        self._waiters: list[_Waiter] = []
+        self._running: int | None = None
+        self._barrier: cf.Future | None = None
+        self._last_grant_wall = _real_time.monotonic()
+        self._max_virtual = max_virtual_seconds
+        self._deadlocked: str | None = None
+
+    # ------------------------------------------------------------------ reads
+
+    def monotonic(self) -> float:
+        return self._now
+
+    def perf_counter(self) -> float:
+        return self._now
+
+    def time(self) -> float:
+        return self._epoch + self._now
+
+    # ------------------------------------------------------------- scheduling
+
+    def _next_seq_locked(self) -> int:
+        self._seq += 1
+        return self._seq
+
+    def _park(
+        self,
+        predicate: Callable[[], bool] | None,
+        deadline: float | None,
+        name: str = "",
+    ) -> bool:
+        with self._lock:
+            if self._deadlocked:
+                raise VirtualDeadlock(self._deadlocked)
+            w = _Waiter(self._next_seq_locked(), predicate, deadline, name)
+            self._waiters.append(w)
+            self._running = None
+            self._dispatch_locked()
+        while not w.event.wait(10.0):
+            with self._lock:
+                if self._deadlocked:
+                    raise VirtualDeadlock(self._deadlocked)
+                stalled = (
+                    _real_time.monotonic() - self._last_grant_wall
+                    > _WALL_STALL_SECONDS
+                )
+            if stalled and not w.event.is_set():
+                raise RuntimeError(
+                    f"virtual clock wedged: no grant for {_WALL_STALL_SECONDS}s "
+                    f"of real time while {name or 'waiter'} was parked "
+                    "(a thread is blocked outside the clock seam)"
+                )
+        if not w.granted:
+            raise VirtualDeadlock(self._deadlocked or "woken without a grant")
+        return w.woke
+
+    def _dispatch_locked(self) -> None:
+        """Grant the next waiter, advancing virtual time if needed."""
+        if self._running is not None or self._barrier is not None:
+            return
+        while True:
+            if self._deadlocked:
+                return
+            runnable = None
+            for w in sorted(self._waiters, key=lambda w: w.seq):
+                if w.predicate is not None and w.predicate():
+                    runnable = w
+                    w.woke = True
+                    break
+                if w.deadline is not None and w.deadline <= self._now:
+                    runnable = w
+                    w.woke = False
+                    break
+            if runnable is not None:
+                self._grant_locked(runnable)
+                return
+            if not self._waiters:
+                return
+            deadlines = [w.deadline for w in self._waiters if w.deadline is not None]
+            if not deadlines:
+                self._deadlocked = (
+                    "all managed threads parked with no armed deadline: "
+                    + ", ".join(w.name or f"seq{w.seq}" for w in self._waiters)
+                )
+                for w in self._waiters:
+                    w.event.set()
+                return
+            self._now = max(self._now, min(deadlines))
+            if self._max_virtual is not None and self._now > self._max_virtual:
+                self._deadlocked = (
+                    f"virtual time exceeded cap {self._max_virtual}s "
+                    "(runaway schedule)"
+                )
+                for w in self._waiters:
+                    w.event.set()
+                return
+
+    def _grant_locked(self, w: _Waiter) -> None:
+        self._waiters.remove(w)
+        self._running = -1  # token now conceptually held by the woken thread
+        self._last_grant_wall = _real_time.monotonic()
+        w.granted = True
+        w.event.set()
+
+    def _release(self) -> None:
+        with self._lock:
+            self._running = None
+            self._dispatch_locked()
+
+    # ------------------------------------------------------------ clock calls
+
+    def sleep(self, seconds: float) -> None:
+        self._park(None, self._now + max(0.0, seconds), name="sleep")
+
+    def wait(self, event: threading.Event, timeout: float | None = None) -> bool:
+        if event.is_set():
+            return True
+        deadline = None if timeout is None else self._now + max(0.0, timeout)
+        return self._park(event.is_set, deadline, name="event-wait")
+
+    def wait_until(
+        self, predicate: Callable[[], bool], timeout: float | None = None
+    ) -> bool:
+        if predicate():
+            return True
+        deadline = None if timeout is None else self._now + max(0.0, timeout)
+        return self._park(predicate, deadline, name="predicate-wait")
+
+    def join_thread(
+        self, thread: threading.Thread, timeout: float | None = None
+    ) -> bool:
+        if isinstance(thread, _VThread):
+            pred = lambda: thread._v_departed  # noqa: E731
+        else:
+            pred = lambda: not thread.is_alive()  # noqa: E731
+        if pred():
+            return True
+        deadline = None if timeout is None else self._now + max(0.0, timeout)
+        return self._park(pred, deadline, name=f"join:{thread.name}")
+
+    def wait_futures(
+        self, futures: Iterable[cf.Future], timeout: float | None = None
+    ) -> Any:
+        futs = list(futures)
+        pred = lambda: all(f.done() for f in futs)  # noqa: E731
+        if futs and not pred():
+            deadline = None if timeout is None else self._now + max(0.0, timeout)
+            self._park(pred, deadline, name="futures-wait")
+        done = {f for f in futs if f.done()}
+        return DoneAndNotDoneFutures(done, {f for f in futs if f not in done})
+
+    # -------------------------------------------------------- thread creation
+
+    def spawn(
+        self,
+        target: Callable[[], Any],
+        *,
+        name: str | None = None,
+        daemon: bool = True,
+    ) -> threading.Thread:
+        with self._lock:
+            ticket = self._next_seq_locked()
+        parked = threading.Event()
+        holder: list[_VThread] = []
+
+        def _run() -> None:
+            self._check_in(ticket, parked, name or "thread")
+            try:
+                target()
+            finally:
+                holder[0]._v_departed = True
+                self._release()
+
+        t = _VThread(target=_run, name=name, daemon=daemon)
+        holder.append(t)
+        t.start()
+        self._await_handshake(parked, name or "thread")
+        return t
+
+    def submit(
+        self, pool: cf.Executor, fn: Callable[..., Any], /, *args: Any, **kwargs: Any
+    ) -> cf.Future:
+        with self._lock:
+            ticket = self._next_seq_locked()
+        parked = threading.Event()
+        cell: list[cf.Future | None] = [None]
+
+        def _wrapped(*a: Any, **k: Any) -> Any:
+            self._check_in(ticket, parked, "pool-task")
+            try:
+                return fn(*a, **k)
+            finally:
+                self._depart_with_barrier(cell[0])
+
+        fut = pool.submit(_wrapped, *args, **kwargs)
+        cell[0] = fut
+        fut.add_done_callback(self._barrier_cleared)
+        self._await_handshake(parked, "pool-task")
+        return fut
+
+    def _check_in(self, ticket: int, parked: threading.Event, name: str) -> None:
+        """New thread/task: park at its pre-assigned ticket, tell the spawner."""
+        with self._lock:
+            if self._deadlocked:
+                parked.set()
+                raise VirtualDeadlock(self._deadlocked)
+            w = _Waiter(ticket, lambda: True, None, name)
+            self._waiters.append(w)
+            parked.set()
+            self._dispatch_locked()
+        while not w.event.wait(10.0):
+            with self._lock:
+                if self._deadlocked:
+                    raise VirtualDeadlock(self._deadlocked)
+        if not w.granted:
+            raise VirtualDeadlock(self._deadlocked or "woken without a grant")
+
+    def _await_handshake(self, parked: threading.Event, name: str) -> None:
+        if not parked.wait(_HANDSHAKE_SECONDS):
+            raise RuntimeError(
+                f"virtual clock: spawned {name} never parked "
+                f"within {_HANDSHAKE_SECONDS}s of real time "
+                "(thread pool saturated beyond its accounting?)"
+            )
+
+    def _depart_with_barrier(self, fut: cf.Future | None) -> None:
+        with self._lock:
+            self._running = None
+            if fut is not None and not fut.done():
+                # Hold all grants until the executor resolves the Future so
+                # the next token holder sees f.done() deterministically.
+                self._barrier = fut
+                return
+            self._dispatch_locked()
+
+    def _barrier_cleared(self, fut: cf.Future) -> None:
+        with self._lock:
+            if self._barrier is fut:
+                self._barrier = None
+                self._dispatch_locked()
+
+    # ------------------------------------------------------------------- root
+
+    def start_root(self) -> None:
+        """The calling (real) thread becomes the first token holder."""
+        with self._lock:
+            self._running = -1
+
+    def finish_root(self) -> None:
+        """Release the root token; remaining parked threads self-drain."""
+        self._release()
+
+    def __enter__(self) -> "VirtualClock":
+        self.start_root()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.finish_root()

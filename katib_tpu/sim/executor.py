@@ -1,0 +1,247 @@
+"""Modeled trial executor + suggester latency for the simulator.
+
+This is the ONLY scheduler-facing piece the simulator replaces: instead of
+compiling and stepping a real program, a dispatched trial draws its
+execution time from the scenario's seeded duration model, waits it out in
+*virtual* time (responsive to stop/drain, exactly like the real runner),
+consults the real :class:`~katib_tpu.utils.faults.FaultInjector` seams
+(``on_trial_attempt``, ``on_cohort_execute``, ``is_device_wedged``) so
+injected faults take the production classification/retry paths, and settles
+with a deterministic modeled metric.  Every duration/metric draw is keyed by
+``(scenario seed, trial name, attempt)`` so the schedule — and therefore the
+journal — is a pure function of the seed regardless of dispatch order.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+import threading
+
+from katib_tpu.core.types import TrialCondition
+from katib_tpu.runner.trial_runner import TrialResult
+from katib_tpu.utils import faults
+from katib_tpu.utils.clock import get_clock
+
+from katib_tpu.sim.scenario import Scenario
+
+
+def _stream(*key: object) -> random.Random:
+    """An independent seeded RNG for one (seed, trial, attempt, ...) key."""
+    h = hashlib.sha256(":".join(str(k) for k in key).encode()).digest()
+    return random.Random(int.from_bytes(h[:8], "big"))
+
+
+def _wait_virtual(clock, events: list[threading.Event], seconds: float) -> bool:
+    """Wait ``seconds`` of clock time; True if any event fired first.
+    Uses the virtual clock's predicate wait when available; falls back to a
+    chunked poll under a real clock (tests at tiny scale)."""
+    live = [e for e in events if e is not None]
+    wait_until = getattr(clock, "wait_until", None)
+    if wait_until is not None:
+        return wait_until(lambda: any(e.is_set() for e in live), seconds)
+    deadline = clock.monotonic() + seconds
+    while clock.monotonic() < deadline:
+        if any(e.is_set() for e in live):
+            return True
+        clock.sleep(min(0.02, seconds))
+    return any(e.is_set() for e in live)
+
+
+class ModeledExecutor:
+    """Callable seams for ``Orchestrator(run_trial_fn=..., run_cohort_fn=...)``."""
+
+    def __init__(self, scenario: Scenario, injector: faults.FaultInjector):
+        self.sc = scenario
+        self.injector = injector
+
+    # -- device placement ---------------------------------------------------
+
+    def _device_of(self, trial_name: str, attempt: int) -> int:
+        """Deterministic placement: each attempt lands on a (re)drawn device
+        so a retry after a device fault can escape the wedged slice — the
+        stand-in for the allocator leasing a different sub-mesh."""
+        rng = _stream(self.sc.seed, "placement", trial_name, attempt)
+        return rng.randrange(self.sc.slices.total_devices)
+
+    # -- the run_trial seam -------------------------------------------------
+
+    def run_trial(
+        self,
+        trial,
+        store,
+        objective,
+        mesh=None,
+        stop_event=None,
+        injector=None,
+        watchdog=None,
+        drain_event=None,
+    ) -> TrialResult:
+        clock = get_clock()
+        inj = injector or self.injector
+        try:
+            # the production seam: may raise InjectedFault (flake /
+            # fail_trial arms) which classifies exactly like a real failure
+            inj.on_trial_attempt(trial)
+        except faults.InjectedFault as e:
+            return TrialResult(
+                TrialCondition.FAILED, str(e), faults.classify_exception(e)
+            )
+        attempt = inj.attempts_of(trial.name)
+        rng = _stream(self.sc.seed, "trial", trial.name, attempt)
+        device = self._device_of(trial.name, attempt)
+        if inj.is_device_wedged(device):
+            return TrialResult(
+                TrialCondition.FAILED,
+                f"injected device fault: dispatch to wedged device {device}",
+                faults.FailureKind.DEVICE,
+            )
+        duration = self.sc.durations.draw(rng)
+        if _wait_virtual(clock, [stop_event, drain_event], duration):
+            if drain_event is not None and drain_event.is_set():
+                return TrialResult(
+                    TrialCondition.DRAINED,
+                    "drain requested: checkpointed at a step boundary",
+                )
+            return TrialResult(TrialCondition.KILLED, "stop requested")
+        if inj.is_device_wedged(device):
+            # the wedge landed mid-flight: the program dies under the trial
+            return TrialResult(
+                TrialCondition.FAILED,
+                f"injected device fault: device {device} wedged during step",
+                faults.FailureKind.DEVICE,
+            )
+        self._settle_metrics(trial, store, objective, rng)
+        return TrialResult(TrialCondition.SUCCEEDED)
+
+    # -- the run_cohort seam ------------------------------------------------
+
+    def run_cohort(
+        self,
+        trials,
+        store,
+        objective,
+        mesh=None,
+        stop_event=None,
+        injector=None,
+        watchdog=None,
+        drain_event=None,
+        buckets=True,
+    ) -> dict:
+        clock = get_clock()
+        inj = injector or self.injector
+        results: dict[str, TrialResult] = {}
+        attempts: dict[str, int] = {}
+        for t in trials:
+            try:
+                inj.on_trial_attempt(t)
+            except faults.InjectedFault as e:
+                results[t.name] = TrialResult(
+                    TrialCondition.FAILED, str(e), faults.classify_exception(e)
+                )
+            attempts[t.name] = inj.attempts_of(t.name)
+        members = [t for t in trials if t.name not in results]
+        if not members:
+            return results
+        # one vectorized program on one sub-mesh: placement keyed by the
+        # first member, the whole cohort shares it
+        lead = members[0]
+        device = self._device_of(lead.name, attempts[lead.name])
+        slice_id = device // self.sc.slices.devices_per_slice
+        device_ids = list(self.sc.slices.slice_devices(slice_id))
+        try:
+            # the production cohort seam: wedged device in the mesh -> one
+            # DEVICE fault for the whole group (elastic degradation path)
+            inj.on_cohort_execute(members, device_ids)
+        except faults.InjectedFault as e:
+            kind = faults.classify_exception(e)
+            for t in members:
+                results[t.name] = TrialResult(TrialCondition.FAILED, str(e), kind)
+            return results
+        duration = max(
+            self.sc.durations.draw(
+                _stream(self.sc.seed, "trial", t.name, attempts[t.name])
+            )
+            for t in members
+        )
+        if _wait_virtual(clock, [stop_event, drain_event], duration):
+            drained = drain_event is not None and drain_event.is_set()
+            for t in members:
+                results[t.name] = (
+                    TrialResult(
+                        TrialCondition.DRAINED,
+                        "drain requested: checkpointed at a step boundary",
+                    )
+                    if drained
+                    else TrialResult(TrialCondition.KILLED, "stop requested")
+                )
+            return results
+        hit = sorted(
+            d for d in device_ids if inj.is_device_wedged(d)
+        )
+        if hit:
+            for t in members:
+                results[t.name] = TrialResult(
+                    TrialCondition.FAILED,
+                    f"injected device fault: wedged device(s) {hit} under cohort",
+                    faults.FailureKind.DEVICE,
+                )
+            return results
+        for t in members:
+            rng = _stream(self.sc.seed, "trial", t.name, attempts[t.name])
+            self.sc.durations.draw(rng)  # keep stream position == singleton path
+            self._settle_metrics(t, store, objective, rng)
+            results[t.name] = TrialResult(TrialCondition.SUCCEEDED)
+        return results
+
+    # -- modeled objective --------------------------------------------------
+
+    def _settle_metrics(self, trial, store, objective, rng: random.Random) -> None:
+        """A deterministic objective surface + seeded noise, reported through
+        the store (the harvest loop builds the reduced Observation from
+        ``store.observation_for`` — a trial with no reported points would
+        settle METRICS_UNAVAILABLE).  Numeric params contribute a smooth
+        bowl; categorical params a per-(name, value) hashed unit draw —
+        enough structure that update_optimal behaves like a real sweep.
+        No builtin ``hash()``: that is salted per-process and would break
+        cross-process determinism."""
+        parts = []
+        for a in trial.spec.assignments:
+            try:
+                x = float(a.value)
+            except (TypeError, ValueError):
+                parts.append(
+                    _stream(self.sc.seed, "cat", a.name, str(a.value)).random()
+                )
+            else:
+                parts.append(1.0 / (1.0 + abs(x)))
+        score = sum(parts) / len(parts) if parts else 0.5
+        value = max(0.0, score + rng.gauss(0.0, self.sc.metric_noise))
+        store.report_point(trial.name, objective.objective_metric_name, value)
+
+
+class LatencySuggester:
+    """Wraps the real suggester: every ``get_suggestions`` call first sleeps
+    a seeded draw from the scenario's suggester latency model — the 0.5 s
+    suggester of ``async_occupancy.json``, made reproducible."""
+
+    def __init__(self, inner, scenario: Scenario):
+        self._inner = inner
+        self._sc = scenario
+        self._calls = 0
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    @property
+    def adaptive(self):
+        return self._inner.adaptive
+
+    def get_suggestions(self, experiment, count):
+        self._calls += 1
+        d = self._sc.suggest_latency.draw(
+            _stream(self._sc.seed, "suggest", self._calls)
+        )
+        if d > 0.0:
+            get_clock().sleep(d)
+        return self._inner.get_suggestions(experiment, count)

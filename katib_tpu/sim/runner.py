@@ -1,0 +1,387 @@
+"""Scenario runner: wire the virtual clock + modeled executor around the
+REAL orchestrator stack and gate the outcome on the invariant checker.
+
+``run_scenario`` is the whole simulator in one call:
+
+1. install a :class:`VirtualClock` as the ambient clock and gate journal
+   fsync off (virtual runs are about schedules, not disk durability);
+2. build a real :class:`ExperimentSpec` (white-box, async engine on) and a
+   real :class:`Orchestrator` whose only substitutions are the modeled
+   trial/cohort executors, a seeded trial-name source, and a
+   latency-wrapped — but real — suggester;
+3. spawn a clock-managed fault-driver thread that walks the scenario's
+   fault schedule in virtual time through the production
+   :class:`FaultInjector` seams (plus ``orch.drain()`` / ``orch.stop()``);
+4. run the experiment, then replay the journal through
+   :mod:`katib_tpu.sim.invariants` and return a deterministic verdict.
+
+Crash scenarios are two-phase: a child process (this module run with
+``python -m katib_tpu.sim.runner``) arms ``KATIB_CRASH_AT`` and dies at a
+registered persistence site; the parent resumes the same workdir and the
+invariant gate runs over the combined journal.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import shutil
+import subprocess
+import sys
+import tempfile
+import threading
+import time as _real_time
+
+from katib_tpu.core.types import (
+    AlgorithmSpec,
+    ExperimentSpec,
+    FeasibleSpace,
+    ObjectiveSpec,
+    ObjectiveType,
+    ParameterSpec,
+    ParameterType,
+    ResumePolicy,
+)
+from katib_tpu.orchestrator import journal as journal_mod
+from katib_tpu.orchestrator.orchestrator import Orchestrator
+from katib_tpu.store.base import MemoryObservationStore
+from katib_tpu.suggest.base import make_suggester
+from katib_tpu.utils import faults
+from katib_tpu.utils import tracing as tracing_mod
+from katib_tpu.utils.clock import get_clock, set_clock
+
+from katib_tpu.sim.clock import VirtualClock
+from katib_tpu.sim.executor import LatencySuggester, ModeledExecutor, _stream
+from katib_tpu.sim.invariants import check_invariants, journal_digest
+from katib_tpu.sim.scenario import Scenario, load_scenario, scenario_to_dict
+
+#: the child half of a two-phase crash scenario sets this so it does not
+#: recurse into spawning another child
+_CHILD_ENV = "KATIB_SIM_CHILD"
+
+
+def _sim_train_fn(ctx):  # pragma: no cover - never dispatched
+    raise RuntimeError(
+        "simulator: the modeled executor must intercept trial dispatch"
+    )
+
+
+def _token_hex_factory(seed: int):
+    """Seeded stand-in for ``secrets.token_hex`` so trial names — which key
+    the journal — are a function of the scenario seed."""
+    rng = _stream(seed, "token-hex")
+
+    def token_hex(nbytes: int = 4) -> str:
+        return f"{rng.getrandbits(8 * nbytes):0{2 * nbytes}x}"
+
+    return token_hex
+
+
+def _build_spec(sc: Scenario) -> ExperimentSpec:
+    params = [
+        ParameterSpec(
+            "lr", ParameterType.DOUBLE, FeasibleSpace(min=1e-4, max=1.0)
+        ),
+        ParameterSpec(
+            "momentum", ParameterType.DOUBLE, FeasibleSpace(min=0.0, max=0.99)
+        ),
+        ParameterSpec(
+            "arch",
+            ParameterType.CATEGORICAL,
+            FeasibleSpace(list=["mlp", "cnn", "gru", "moe"]),
+        ),
+    ]
+    spec = ExperimentSpec(
+        name=f"sim-{sc.name}",
+        objective=ObjectiveSpec(
+            type=ObjectiveType.MAXIMIZE, objective_metric_name="score"
+        ),
+        algorithm=AlgorithmSpec(name=sc.algorithm, settings={"seed": str(sc.seed)}),
+        parameters=params,
+        parallel_trial_count=sc.parallel,
+        max_trial_count=sc.trials,
+        train_fn=_sim_train_fn,
+        async_orch=True,
+        prewarm=False,  # its worker thread lives outside the clock seam
+        max_retries=2,
+        retry_backoff_seconds=0.25,
+        drain_grace_seconds=10.0,
+    )
+    if sc.crash is not None:
+        # the parent phase resumes the child's workdir, suggester state
+        # included — exactly what LongRunning is for
+        spec = dataclasses.replace(spec, resume_policy=ResumePolicy.LONG_RUNNING)
+    if sc.spec:
+        overrides = dict(sc.spec)
+        if isinstance(overrides.get("resume_policy"), str):
+            overrides["resume_policy"] = ResumePolicy(overrides["resume_policy"])
+        spec = dataclasses.replace(spec, **overrides)
+    return spec
+
+
+def _fault_schedule(sc: Scenario, orch: Orchestrator, inj: faults.FaultInjector):
+    """Expand the scenario's fault list (plus clear_after events) into a
+    time-sorted list of (virtual_time, description, thunk)."""
+    out: list[tuple[float, str, object]] = []
+
+    def add(t, desc, fn):
+        out.append((float(t), desc, fn))
+
+    for f in sc.faults:
+        if f.action == "kill_loop":
+            loop = f.loop or "suggest"
+            add(f.at, f"kill_loop:{loop}", lambda loop=loop: inj.kill_loop_now(loop))
+        elif f.action == "stall_suggester":
+            s = f.seconds or 10.0
+            add(f.at, f"stall_suggester:{s}", lambda s=s: inj.stall_suggester_now(s))
+        elif f.action == "wedge_device":
+            add(f.at, f"wedge_device:{f.device}",
+                lambda d=f.device: inj.wedge_device(d))
+            if f.clear_after is not None:
+                add(f.at + f.clear_after, f"unwedge_device:{f.device}",
+                    lambda d=f.device: inj.unwedge_device(d))
+        elif f.action == "drop_slice":
+            devs = list(sc.slices.slice_devices(f.slice))
+            add(f.at, f"drop_slice:{f.slice}",
+                lambda devs=devs: [inj.wedge_device(d) for d in devs])
+            if f.clear_after is not None:
+                add(f.at + f.clear_after, f"restore_slice:{f.slice}",
+                    lambda devs=devs: [inj.unwedge_device(d) for d in devs])
+        elif f.action == "flake":
+            kind = faults.FailureKind(f.kind)
+            add(f.at, f"flake:{f.rate}",
+                lambda r=f.rate, k=kind: inj.flake(r, k))
+            if f.clear_after is not None:
+                add(f.at + f.clear_after, "flake:clear", lambda: inj.flake(0.0))
+        elif f.action == "drain":
+            add(f.at, "drain", orch.drain)
+        elif f.action == "stop":
+            add(f.at, "stop", orch.stop)
+        else:
+            raise ValueError(f"unknown fault action {f.action!r}")
+    out.sort(key=lambda e: e[0])
+    return out
+
+
+def _drive_faults(schedule, halt: threading.Event) -> None:
+    clock = get_clock()
+    for at, _desc, fn in schedule:
+        delta = at - clock.monotonic()
+        if delta > 0 and clock.wait(halt, delta):
+            return
+        if halt.is_set():
+            return
+        fn()
+
+
+def _run_phase(
+    sc: Scenario, workdir: str, *, resume: bool, crashed: bool
+) -> dict:
+    """One in-process simulated run (everything except the crash child)."""
+    spec = _build_spec(sc)
+    injector = faults.FaultInjector(rng=_stream(sc.seed, "injector"))
+    executor = ModeledExecutor(sc, injector)
+    clock = VirtualClock(max_virtual_seconds=sc.virtual_cap())
+    # each compaction serializes the full experiment state (O(trials)), so
+    # the auto cadence keeps total compaction work O(trials): a handful of
+    # snapshots over the run, not one per fixed batch
+    snapshot_every = (
+        sc.snapshot_every
+        if sc.snapshot_every is not None
+        else max(64, sc.trials // 4)
+    )
+    orch = Orchestrator(
+        store=MemoryObservationStore(),
+        workdir=workdir,
+        poll_interval=sc.poll_interval,
+        fault_injector=injector,
+        preflight=False,
+        run_trial_fn=executor.run_trial,
+        run_cohort_fn=executor.run_cohort,
+        token_hex=_token_hex_factory(sc.seed),
+        journal_snapshot_every=snapshot_every,
+        status_publish_interval=sc.status_publish_interval,
+        suggester_fn=lambda s: LatencySuggester(make_suggester(s), sc),
+    )
+    halt = threading.Event()
+    prev_clock = set_clock(clock)
+    # fsync and span tracing are real-time I/O with no virtual-time meaning;
+    # both gates are saved/restored so the ambient process is untouched
+    prev_sync = os.environ.get(journal_mod.SYNC_ENV)
+    os.environ[journal_mod.SYNC_ENV] = "0"
+    prev_trace = os.environ.get(tracing_mod.TRACE_ENV)
+    os.environ[tracing_mod.TRACE_ENV] = "0"
+    wall0 = _real_time.monotonic()
+    error = None
+    exp = None
+    try:
+        with clock:
+            schedule = _fault_schedule(sc, orch, injector)
+            driver = None
+            if schedule:
+                driver = clock.spawn(
+                    lambda: _drive_faults(schedule, halt),
+                    name="sim-fault-driver",
+                )
+            try:
+                exp = orch.run(spec, resume=resume)
+            finally:
+                halt.set()
+                if driver is not None:
+                    clock.join_thread(driver)
+        virtual_seconds = clock.monotonic()
+    except Exception as e:  # noqa: BLE001 - verdictized, not swallowed
+        error = f"{type(e).__name__}: {e}"
+        virtual_seconds = clock.monotonic()
+    finally:
+        set_clock(prev_clock)
+        if prev_sync is None:
+            os.environ.pop(journal_mod.SYNC_ENV, None)
+        else:
+            os.environ[journal_mod.SYNC_ENV] = prev_sync
+        if prev_trace is None:
+            os.environ.pop(tracing_mod.TRACE_ENV, None)
+        else:
+            os.environ[tracing_mod.TRACE_ENV] = prev_trace
+    wall_seconds = _real_time.monotonic() - wall0
+
+    if exp is not None:
+        violations = check_invariants(
+            sc, sc.seed, exp, orch, workdir, crashed=crashed
+        )
+    else:
+        violations = [f"run crashed in-process: {error}"]
+    stats = getattr(orch, "async_stats", None) or {}
+    return {
+        "scenario": sc.name,
+        "seed": sc.seed,
+        "experiment": spec.name,
+        "condition": exp.condition.value if exp is not None else "Error",
+        "trials": len(exp.trials) if exp is not None else 0,
+        "settled": stats.get("trials_settled"),
+        "occupancy": stats.get("sustained_occupancy"),
+        "loop_restarts": stats.get("loop_restarts") or {},
+        "fallback": stats.get("fallback"),
+        "virtual_seconds": round(virtual_seconds, 3),
+        "wall_seconds": round(wall_seconds, 3),
+        "journal_sha256": journal_digest(workdir, spec.name),
+        "violations": violations,
+        "verdict": "PASS" if not violations else "FAIL",
+    }
+
+
+def _run_crash(sc: Scenario, workdir: str) -> dict:
+    """Two-phase crash scenario: child dies at the armed persistence site,
+    parent resumes the same workdir, invariants run over the whole story."""
+    crash = sc.crash
+    scenario_path = os.path.join(workdir, "_scenario.yaml")
+    with open(scenario_path, "w", encoding="utf-8") as f:
+        import yaml
+
+        f.write(yaml.safe_dump(scenario_to_dict(sc), sort_keys=False))
+    pkg_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    env = dict(os.environ)
+    env[_CHILD_ENV] = "1"
+    env[faults.CRASH_AT_ENV] = f"{crash.at}:{crash.hit}"
+    env[faults.CRASH_MODE_ENV] = crash.mode
+    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "katib_tpu.sim.runner", scenario_path,
+            "--seed", str(sc.seed), "--workdir", workdir,
+        ],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    pre: list[str] = []
+    # "exit" mode calls os._exit(137); "kill" mode raises SIGKILL, which
+    # subprocess reports as returncode -9
+    expected = {137} if crash.mode == "exit" else {-9, 137}
+    if proc.returncode not in expected:
+        pre.append(
+            f"crash: child exited {proc.returncode} (expected "
+            f"{sorted(expected)} from {crash.at}:{crash.hit}); "
+            f"stderr tail: {proc.stderr[-400:]!r}"
+        )
+    verdict = _run_phase(sc, workdir, resume=True, crashed=True)
+    verdict["crash"] = {
+        "site": crash.at,
+        "hit": crash.hit,
+        "mode": crash.mode,
+        "child_exit": proc.returncode,
+    }
+    if pre:
+        verdict["violations"] = pre + verdict["violations"]
+        verdict["verdict"] = "FAIL"
+    return verdict
+
+
+def run_scenario(
+    scenario: Scenario, seed: int | None = None, workdir: str | None = None
+) -> dict:
+    """Run one scenario to a deterministic verdict dict.
+
+    ``seed`` overrides the scenario's committed seed; ``workdir`` pins the
+    experiment directory (same seed + same workdir → byte-identical
+    journal).  A temporary workdir is created — and removed on a PASS —
+    when none is given.
+    """
+    sc = (
+        scenario
+        if seed is None or seed == scenario.seed
+        else dataclasses.replace(scenario, seed=seed)
+    )
+    owns_workdir = workdir is None
+    if owns_workdir:
+        workdir = tempfile.mkdtemp(prefix=f"katib-sim-{sc.name}-")
+    try:
+        if sc.crash is not None and os.environ.get(_CHILD_ENV) != "1":
+            verdict = _run_crash(sc, workdir)
+        else:
+            verdict = _run_phase(sc, workdir, resume=False, crashed=False)
+    except BaseException:
+        owns_workdir = False  # keep the evidence
+        raise
+    finally:
+        if owns_workdir and os.path.isdir(workdir):
+            shutil.rmtree(workdir, ignore_errors=True)
+    verdict["workdir"] = None if owns_workdir else workdir
+    return verdict
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m katib_tpu.sim.runner",
+        description="Run one simulator scenario to a verdict.",
+    )
+    p.add_argument("scenario", help="scenario YAML path")
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--workdir", default=None)
+    p.add_argument("--json", action="store_true", dest="as_json")
+    args = p.parse_args(argv)
+    verdict = run_scenario(
+        load_scenario(args.scenario), seed=args.seed, workdir=args.workdir
+    )
+    if args.as_json:
+        print(json.dumps(verdict, indent=2, sort_keys=True))
+    else:
+        print(
+            f"{verdict['verdict']}: {verdict['scenario']} seed={verdict['seed']} "
+            f"trials={verdict['trials']} virtual={verdict['virtual_seconds']}s "
+            f"wall={verdict['wall_seconds']}s"
+        )
+        for v in verdict["violations"]:
+            print(f"  violation: {v}")
+    return 0 if verdict["verdict"] == "PASS" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

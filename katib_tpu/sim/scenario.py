@@ -1,0 +1,275 @@
+"""Scenario spec for the virtual-time simulator.
+
+A scenario is a small YAML document describing one simulated experiment:
+trial count, parallelism, the suggester + its latency model, modeled trial
+duration distributions (seeded from committed bench numbers,
+``artifacts/orchestrator/*.json``), a simulated slice topology, a fault
+schedule in virtual time, and the invariant expectations the run must meet.
+
+Example::
+
+    name: mixed-faults
+    trials: 20000
+    parallel: 32
+    seed: 7
+    poll_interval: 0.25
+    suggester:
+      algorithm: random
+      latency: {distribution: lognormal, mean: 0.5, sigma: 0.25}
+    durations:
+      distribution: lognormal
+      mean: 0.2
+      sigma: 0.3
+      straggler_rate: 0.01
+      straggler_factor: 8.0
+    slices: {count: 4, devices_per_slice: 8}
+    faults:
+      - {at: 30.0, action: kill_loop, loop: suggest}
+      - {at: 60.0, action: drop_slice, slice: 2, clear_after: 30.0}
+      - {at: 95.0, action: stall_suggester, seconds: 12.0}
+    expect:
+      restarts: true
+      occupancy_min: 0.5
+
+Everything has a default; ``katib-tpu sim scenario.yaml --seed N`` overrides
+the seed from the CLI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from dataclasses import dataclass, field
+
+
+@dataclass
+class LatencyModel:
+    """A seeded duration distribution (seconds)."""
+
+    distribution: str = "constant"  # constant | uniform | lognormal
+    mean: float = 0.0
+    sigma: float = 0.0  # lognormal shape / uniform half-width
+    min: float = 0.0
+    max: float = math.inf
+
+    def draw(self, rng) -> float:
+        if self.distribution == "constant" or self.mean <= 0.0:
+            d = self.mean
+        elif self.distribution == "uniform":
+            d = rng.uniform(
+                max(0.0, self.mean - self.sigma), self.mean + self.sigma
+            )
+        elif self.distribution == "lognormal":
+            # parameterized by the distribution MEAN (matches the committed
+            # bench numbers), not the underlying mu
+            mu = math.log(self.mean) - 0.5 * self.sigma**2
+            d = rng.lognormvariate(mu, self.sigma)
+        else:
+            raise ValueError(f"unknown distribution {self.distribution!r}")
+        return min(max(d, self.min), self.max)
+
+
+@dataclass
+class DurationModel(LatencyModel):
+    """Trial execution time + heavy-tail straggler model."""
+
+    distribution: str = "lognormal"
+    mean: float = 0.2  # async_occupancy.json train block
+    sigma: float = 0.3
+    straggler_rate: float = 0.0
+    straggler_factor: float = 8.0
+
+    def draw(self, rng) -> float:
+        d = super().draw(rng)
+        if self.straggler_rate > 0.0 and rng.random() < self.straggler_rate:
+            d *= self.straggler_factor
+        return d
+
+
+@dataclass
+class SliceTopology:
+    count: int = 1
+    devices_per_slice: int = 8
+
+    @property
+    def total_devices(self) -> int:
+        return self.count * self.devices_per_slice
+
+    def slice_devices(self, slice_id: int) -> range:
+        d = self.devices_per_slice
+        return range(slice_id * d, (slice_id + 1) * d)
+
+
+@dataclass
+class FaultEvent:
+    """One scheduled fault in virtual time.
+
+    Actions: ``kill_loop`` (loop=suggest|schedule|harvest),
+    ``stall_suggester`` (seconds), ``wedge_device`` (device),
+    ``drop_slice`` (slice), ``flake`` (rate, kind), ``drain``, ``stop``.
+    ``clear_after`` un-wedges a device/slice that much later.
+    """
+
+    at: float
+    action: str
+    loop: str = ""
+    seconds: float = 0.0
+    device: int = -1
+    slice: int = -1
+    rate: float = 0.0
+    kind: str = "Transient"
+    clear_after: float | None = None
+
+
+@dataclass
+class Expectations:
+    """What the invariant gate tolerates for this scenario."""
+
+    restarts: bool = False  # loop restarts are an expected outcome
+    fallback: bool = False  # sync-fallback is an expected outcome
+    failed: bool = False  # a FAILED experiment verdict is expected
+    occupancy_min: float = 0.0  # sustained-occupancy floor (0 = skip)
+
+
+@dataclass
+class CrashSpec:
+    """Two-phase crash-kill scenario: a child process dies at a PR 10 crash
+    point (``utils.faults.CRASH_POINTS``), the parent resumes the same
+    workdir and the invariant gate runs over the combined journal."""
+
+    at: str = "journal.append"
+    hit: int = 1
+    mode: str = "exit"  # exit | kill
+
+
+@dataclass
+class Scenario:
+    name: str = "scenario"
+    trials: int = 1000
+    parallel: int = 16
+    seed: int = 0
+    poll_interval: float = 0.25
+    algorithm: str = "random"
+    suggest_latency: LatencyModel = field(
+        default_factory=lambda: LatencyModel(
+            distribution="lognormal", mean=0.5, sigma=0.25
+        )
+    )
+    durations: DurationModel = field(default_factory=DurationModel)
+    slices: SliceTopology = field(default_factory=SliceTopology)
+    faults: list[FaultEvent] = field(default_factory=list)
+    expect: Expectations = field(default_factory=Expectations)
+    crash: CrashSpec | None = None
+    # ExperimentSpec passthrough overrides (max_retries, cohort_width, ...)
+    spec: dict = field(default_factory=dict)
+    # journal compaction cadence in the simulated run (None = auto: big
+    # enough that compaction stays O(trials))
+    snapshot_every: int | None = None
+    # status.json republish throttle (virtual seconds)
+    status_publish_interval: float = 10.0
+    # hard virtual-time cap; None = auto from the workload size
+    max_virtual_seconds: float | None = None
+    # metric noise in the modeled objective
+    metric_noise: float = 0.05
+
+    def virtual_cap(self) -> float:
+        if self.max_virtual_seconds is not None:
+            return self.max_virtual_seconds
+        # generous: all trials serially at mean duration + suggester time,
+        # plus a flat allowance for fault recovery windows
+        serial = self.trials * (
+            self.durations.mean + self.suggest_latency.mean
+        )
+        return max(600.0, 20.0 * serial / max(1, self.parallel) + 600.0)
+
+
+def _build(cls, data: dict, where: str):
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    unknown = set(data) - set(fields)
+    if unknown:
+        raise ValueError(
+            f"{where}: unknown key(s) {sorted(unknown)} "
+            f"(known: {sorted(fields)})"
+        )
+    return cls(**data)
+
+
+def scenario_from_dict(data: dict) -> Scenario:
+    """Validate + build a Scenario from parsed YAML/JSON."""
+    data = dict(data or {})
+    out: dict = {}
+    for key in (
+        "name", "trials", "parallel", "seed", "poll_interval", "algorithm",
+        "spec", "snapshot_every", "status_publish_interval",
+        "max_virtual_seconds", "metric_noise",
+    ):
+        if key in data:
+            out[key] = data.pop(key)
+    sug = data.pop("suggester", None)
+    if sug:
+        if "algorithm" in sug:
+            out["algorithm"] = sug["algorithm"]
+        if "latency" in sug:
+            out["suggest_latency"] = _build(
+                LatencyModel, sug["latency"], "suggester.latency"
+            )
+    if "durations" in data:
+        out["durations"] = _build(DurationModel, data.pop("durations"), "durations")
+    if "slices" in data:
+        out["slices"] = _build(SliceTopology, data.pop("slices"), "slices")
+    if "expect" in data:
+        out["expect"] = _build(Expectations, data.pop("expect"), "expect")
+    if "crash" in data:
+        out["crash"] = _build(CrashSpec, data.pop("crash"), "crash")
+    if "faults" in data:
+        out["faults"] = [
+            _build(FaultEvent, f, f"faults[{i}]")
+            for i, f in enumerate(data.pop("faults"))
+        ]
+    if data:
+        raise ValueError(
+            f"scenario: unknown top-level key(s) {sorted(data)}"
+        )
+    return _build(Scenario, out, "scenario")
+
+
+def scenario_to_dict(sc: Scenario) -> dict:
+    """Inverse of :func:`scenario_from_dict` (used to hand a scenario to the
+    crash-phase child process): round-trips through the loader."""
+    return {
+        "name": sc.name,
+        "trials": sc.trials,
+        "parallel": sc.parallel,
+        "seed": sc.seed,
+        "poll_interval": sc.poll_interval,
+        "suggester": {
+            "algorithm": sc.algorithm,
+            "latency": dataclasses.asdict(sc.suggest_latency),
+        },
+        "durations": dataclasses.asdict(sc.durations),
+        "slices": dataclasses.asdict(sc.slices),
+        "faults": [dataclasses.asdict(f) for f in sc.faults],
+        "expect": dataclasses.asdict(sc.expect),
+        **({"crash": dataclasses.asdict(sc.crash)} if sc.crash else {}),
+        "spec": dict(sc.spec),
+        "snapshot_every": sc.snapshot_every,
+        "status_publish_interval": sc.status_publish_interval,
+        "max_virtual_seconds": sc.max_virtual_seconds,
+        "metric_noise": sc.metric_noise,
+    }
+
+
+def load_scenario(path: str) -> Scenario:
+    """Load a scenario YAML (or JSON — YAML is a superset) file."""
+    import yaml
+
+    with open(path, encoding="utf-8") as f:
+        doc = yaml.safe_load(f) or {}
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: scenario document must be a mapping")
+    sc = scenario_from_dict(doc)
+    if sc.name == "scenario":
+        import os
+
+        sc.name = os.path.splitext(os.path.basename(path))[0]
+    return sc

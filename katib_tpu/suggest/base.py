@@ -138,7 +138,6 @@ def _call_suggester_deadline(
     """Deadline wrapper: the call itself runs (fault-isolated, no breaker —
     the outer frame owns the verdict) on a daemon thread; a timeout is a
     breaker failure with a "deadline" diagnosis."""
-    import threading
     import traceback as _traceback
 
     box: dict = {}
@@ -156,14 +155,16 @@ def _call_suggester_deadline(
             box["traceback"] = _traceback.format_exc(limit=20)
             box["result"] = ([], "error")
 
-    t = threading.Thread(target=_worker, name="katib-suggest-call", daemon=True)
-    t.start()
+    from katib_tpu.utils.clock import get_clock
+
+    clock = get_clock()
+    t = clock.spawn(_worker, name="katib-suggest-call", daemon=True)
     waited = 0.0
     poll = min(0.05, deadline)
     while waited < deadline and t.is_alive():
         if any(ev.is_set() for ev in events):
             break
-        t.join(poll)
+        clock.join_thread(t, poll)
         waited += poll
     if "result" not in box:
         if breaker is not None:

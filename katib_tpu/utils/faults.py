@@ -40,6 +40,8 @@ import random
 import threading
 import time
 
+from katib_tpu.utils.clock import get_clock
+
 
 class FailureKind(str, enum.Enum):
     """Why a trial attempt failed — the retry decision in one bit.
@@ -213,6 +215,11 @@ class Backoff:
     suggester-timeout retries) because it decorrelates their wakeups.
     ``wait`` sleeps through ``stop_event.wait`` so a requested experiment
     stop is never delayed by a pending retry.
+
+    Both time and randomness are injectable: ``clock`` (a ``utils.clock``
+    Clock; None = the ambient one, which the simulator swaps for virtual
+    time) and ``rng`` (a ``random.Random``; overrides ``seed`` so the chaos
+    soak and the simulator can hand every actor a stream off one root seed).
     """
 
     def __init__(
@@ -223,13 +230,16 @@ class Backoff:
         jitter: float = 0.25,
         seed=None,
         full_jitter: bool = False,
+        clock=None,
+        rng: random.Random | None = None,
     ):
         self.base = max(0.0, float(base))
         self.factor = float(factor)
         self.cap = float(cap)
         self.jitter = float(jitter)
         self.full_jitter = bool(full_jitter)
-        self._rng = random.Random(seed)
+        self._clock = clock
+        self._rng = rng if rng is not None else random.Random(seed)
 
     def delay(self, attempt: int) -> float:
         d = min(self.base * self.factor ** max(0, attempt - 1), self.cap)
@@ -243,10 +253,11 @@ class Backoff:
         """Sleep out the attempt's delay.  Returns False when interrupted by
         ``stop_event`` (the caller should abandon the retry)."""
         d = self.delay(attempt)
+        clock = self._clock if self._clock is not None else get_clock()
         if stop_event is None:
-            time.sleep(d)
+            clock.sleep(d)
             return True
-        return not stop_event.wait(d)
+        return not clock.wait(stop_event, d)
 
 
 # ---------------------------------------------------------------------------
@@ -276,12 +287,15 @@ class CircuitBreaker:
         threshold: int = 5,
         base_cooldown: float = 0.05,
         cap: float = 30.0,
-        clock=time.monotonic,
+        clock=None,
     ):
         self.threshold = max(1, int(threshold))
         self.base_cooldown = float(base_cooldown)
         self.cap = float(cap)
-        self._clock = clock
+        # bare monotonic callable; None = the ambient injectable clock
+        self._clock = clock if clock is not None else (
+            lambda: get_clock().monotonic()
+        )
         self.failures = 0
         self.last_failure = ""
         self._retry_at = 0.0
@@ -365,9 +379,15 @@ class FaultInjector:
     fired, for assertions and the ``katib-tpu chaos`` report.
     """
 
-    def __init__(self, seed: int = 0):
+    def __init__(
+        self,
+        seed: int = 0,
+        rng: random.Random | None = None,
+        clock=None,
+    ):
         self.seed = seed
-        self._rng = random.Random(seed)
+        self._rng = rng if rng is not None else random.Random(seed)
+        self._clock = clock  # None = ambient (utils.clock.get_clock())
         self._lock = threading.Lock()
         self._trial_faults: dict[tuple[object, int], FailureKind] = {}
         self._suggester_calls: set[int] = set()
@@ -462,6 +482,22 @@ class FaultInjector:
         self._suggester_stalls[int(call)] = float(seconds)
         return self
 
+    def kill_loop_now(self, loop: str):
+        """Time-indexed arming (the simulator's fault schedule): kill loop
+        ``loop`` at whatever its NEXT iteration happens to be, instead of a
+        pre-counted iteration number."""
+        with self._lock:
+            n = self._loop_iters.get(str(loop), 0) + 1
+            self._loop_kills.setdefault(str(loop), []).append(n)
+        return self
+
+    def stall_suggester_now(self, seconds: float):
+        """Time-indexed arming: stall whichever ``get_suggestions`` call
+        comes next for ``seconds``."""
+        with self._lock:
+            self._suggester_stalls[self._suggester_count + 1] = float(seconds)
+        return self
+
     # -- seams --------------------------------------------------------------
 
     def attempts_of(self, trial_name: str) -> int:
@@ -522,11 +558,12 @@ class FaultInjector:
             stall = self._suggester_stalls.pop(n, 0.0)
         if stall > 0.0:
             self.log.append({"seam": "suggester-stall", "call": n, "seconds": stall})
-            deadline = time.monotonic() + stall
-            while time.monotonic() < deadline:
+            clock = self._clock if self._clock is not None else get_clock()
+            deadline = clock.monotonic() + stall
+            while clock.monotonic() < deadline:
                 if any(ev.is_set() for ev in events):
                     break
-                time.sleep(poll)
+                clock.sleep(poll)
         if n in self._suggester_calls:
             self.log.append({"seam": "suggester", "call": n})
             raise InjectedFault(f"injected suggester fault: call={n}")
@@ -558,10 +595,11 @@ class FaultInjector:
         if delay <= 0.0:
             return
         self.log.append({"seam": "metrics", "trial": trial.name, "delay": delay})
+        clock = self._clock if self._clock is not None else get_clock()
         if stop_event is not None:
-            stop_event.wait(delay)
+            clock.wait(stop_event, delay)
         else:
-            time.sleep(delay)
+            clock.sleep(delay)
 
     def maybe_hang(self, trial, events: tuple = (), poll: float = 0.02) -> None:
         """Runner seam, called inside the white-box trial body: when a
@@ -582,9 +620,10 @@ class FaultInjector:
                 return
             self._hangs.discard(key)
         self.log.append({"seam": "hang", "trial": name, "attempt": attempt})
+        clock = self._clock if self._clock is not None else get_clock()
         live = [e for e in events if e is not None]
         while not any(e.is_set() for e in live):
-            time.sleep(poll)
+            clock.sleep(poll)
 
     def maybe_compile_hang(self, trial, events: tuple = (), poll: float = 0.02) -> None:
         """Runner seam, called where jit compile / first dispatch would run:
@@ -605,9 +644,10 @@ class FaultInjector:
                 return
             self._compile_hangs.discard(key)
         self.log.append({"seam": "compile-hang", "trial": name, "attempt": attempt})
+        clock = self._clock if self._clock is not None else get_clock()
         live = [e for e in events if e is not None]
         while not any(e.is_set() for e in live):
-            time.sleep(poll)
+            clock.sleep(poll)
 
     def is_device_wedged(self, device_id: int) -> bool:
         """Prober seam (``utils.meshhealth``): True when ``wedge_device``

@@ -39,6 +39,20 @@ from typing import Any, Iterator
 
 TRACE_FILE = "trace.jsonl"
 
+TRACE_ENV = "KATIB_TRACE"
+
+
+def enabled() -> bool:
+    """Span-tracing kill switch: ``KATIB_TRACE=0`` (or ``false``/``off``)
+    suppresses the per-experiment trace journal.  Tracing is best-effort by
+    contract, and at sweep scale (tens of thousands of short trials — e.g.
+    the virtual-time simulator) the per-span write+flush is pure overhead."""
+    return os.environ.get(TRACE_ENV, "1").strip().lower() not in (
+        "0",
+        "false",
+        "off",
+    )
+
 
 def trace_path(workdir: str, experiment_name: str) -> str:
     return os.path.join(workdir, experiment_name, TRACE_FILE)

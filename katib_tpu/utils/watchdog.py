@@ -35,10 +35,10 @@ Stdlib-only (no jax) and clock-injectable for deterministic tests.
 from __future__ import annotations
 
 import threading
-import time
 from typing import Callable
 
 from katib_tpu.analysis import guarded_by, make_lock
+from katib_tpu.utils.clock import get_clock
 
 
 class Heartbeat:
@@ -108,9 +108,11 @@ class Watchdog:
     # and a stale read only delays hang detection by one scan interval.
     _GUARDS = guarded_by(_lock=("_beats", "_thread", "hang_count"))
 
-    def __init__(self, interval: float = 0.25, clock=time.monotonic, start: bool = True):
+    def __init__(self, interval: float = 0.25, clock=None, start: bool = True):
         self.interval = float(interval)
-        self._clock = clock
+        # None = the ambient injectable clock (utils.clock); tests and the
+        # supervisor may still inject a bare callable.
+        self._clock = clock if clock is not None else (lambda: get_clock().monotonic())
         self._autostart = bool(start)
         self._lock = make_lock("watchdog.beats")
         self._beats: list[Heartbeat] = []
@@ -134,10 +136,9 @@ class Watchdog:
             self._beats.append(hb)
             if self._thread is None and self._autostart:
                 self._stop.clear()
-                self._thread = threading.Thread(
-                    target=self._monitor, name="katib-watchdog", daemon=True
+                self._thread = get_clock().spawn(
+                    self._monitor, name="katib-watchdog", daemon=True
                 )
-                self._thread.start()
         return hb
 
     def unregister(self, hb: Heartbeat) -> None:
@@ -158,7 +159,7 @@ class Watchdog:
             thread = self._thread
             self._thread = None
         if thread is not None:
-            thread.join(timeout=2.0)
+            get_clock().join_thread(thread, timeout=2.0)
 
     def check_now(self) -> list[str]:
         """Run one scan synchronously (deterministic tests with a fake
@@ -168,7 +169,7 @@ class Watchdog:
     # -- internals ----------------------------------------------------------
 
     def _monitor(self) -> None:
-        while not self._stop.wait(self.interval):
+        while not get_clock().wait(self._stop, self.interval):
             self._scan()
 
     def _scan(self) -> list[str]:

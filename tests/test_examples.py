@@ -16,8 +16,12 @@ from katib_tpu.orchestrator import Orchestrator
 from katib_tpu.sdk.yaml_spec import experiment_spec_from_dict, load_experiment_yaml
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# examples/sim/ holds simulator scenarios, not experiment specs; they are
+# loaded/validated by tests/test_sim.py instead.
 EXAMPLES = sorted(
-    glob.glob(os.path.join(REPO, "examples", "**", "*.yaml"), recursive=True)
+    p
+    for p in glob.glob(os.path.join(REPO, "examples", "**", "*.yaml"), recursive=True)
+    if os.path.basename(os.path.dirname(p)) != "sim"
 )
 REFERENCE_EXAMPLES = "/root/reference/examples/v1beta1"
 
